@@ -1,0 +1,27 @@
+"""attendance_tpu — a TPU-native real-time attendance sketch framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the reference
+real-time student attendance pipeline (Pulsar -> Bloom validation ->
+HyperLogLog unique counting -> Cassandra persistence -> batch analytics),
+re-designed TPU-first: the per-event Redis sketch round-trips of the
+reference's hot loop (reference: attendance_processor.py:100-136) become
+micro-batched on-device kernels over HBM-resident sketch state.
+
+Layering (mirrors SURVEY.md §1, rebuilt TPU-native):
+  config          flag/config layer (reference contract: config/config.py)
+  ops/            hashing + device kernels (XLA + Pallas)
+  models/         sketch data structures: Bloom filter, HyperLogLog
+  sketch/         Redis-command-compatible SketchStore facade
+  transport/      event transport (Pulsar-semantics in-memory queue + gated
+                  real Pulsar backend)
+  storage/        persistent event store (Cassandra-semantics table + gated
+                  real Cassandra backend)
+  pipeline/       generator / micro-batched processor / analyzer
+  parallel/       multi-chip sharding: hash-prefix sharded sketches under
+                  shard_map with OR/max collectives
+  utils/          logging, metrics, snapshot/restore, profiling
+"""
+
+__version__ = "0.1.0"
+
+from attendance_tpu.config import Config, DEFAULT_CONFIG  # noqa: F401

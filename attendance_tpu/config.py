@@ -1,0 +1,159 @@
+"""Config/flag layer.
+
+The reference has no flag system: every script imports 10 module-level
+constants from a ``config/config.py`` that is absent from its repo (contract
+defined by the imports at reference data_generator.py:13-16,
+attendance_processor.py:13-17, attendance_analysis.py:9). This module keeps
+those 10 names as the compatibility contract (same defaults as the
+reference's README where stated) and adds a real argparse flag layer whose
+first citizen is ``--sketch-backend={redis,tpu,memory}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import List, Optional
+
+# ---------------------------------------------------------------------------
+# The 10 reference constants (contract: SURVEY.md §1 L0).
+# ---------------------------------------------------------------------------
+PULSAR_HOST = "pulsar://localhost:6650"
+PULSAR_TOPIC = "attendance-events"
+REDIS_HOST = "localhost"
+REDIS_PORT = 6379
+CASSANDRA_HOSTS = ["localhost"]
+CASSANDRA_KEYSPACE = "attendance_system"
+BLOOM_FILTER_KEY = "bf:students"
+BLOOM_FILTER_ERROR_RATE = 0.01  # reference README.md:238-239
+BLOOM_FILTER_CAPACITY = 100_000  # reference README.md:104
+HLL_KEY_PREFIX = "hll:unique:"  # reference attendance_processor.py:128
+
+
+@dataclasses.dataclass
+class Config:
+    """Full framework configuration.
+
+    The first block mirrors the reference constants verbatim; the second
+    block is new, TPU-native configuration (micro-batching, sketch layout,
+    sharding) with conservative defaults.
+    """
+
+    # --- reference contract ---
+    pulsar_host: str = PULSAR_HOST
+    pulsar_topic: str = PULSAR_TOPIC
+    redis_host: str = REDIS_HOST
+    redis_port: int = REDIS_PORT
+    cassandra_hosts: List[str] = dataclasses.field(
+        default_factory=lambda: list(CASSANDRA_HOSTS))
+    cassandra_keyspace: str = CASSANDRA_KEYSPACE
+    bloom_filter_key: str = BLOOM_FILTER_KEY
+    bloom_filter_error_rate: float = BLOOM_FILTER_ERROR_RATE
+    bloom_filter_capacity: int = BLOOM_FILTER_CAPACITY
+    hll_key_prefix: str = HLL_KEY_PREFIX
+
+    # --- TPU-native additions ---
+    # Backend for the sketch path (BF.*/PFADD/PFCOUNT). "tpu" = device
+    # arrays + jitted kernels; "memory" = pure-python host sketches (hermetic
+    # tests, no JAX); "redis" = real Redis Stack (import-gated).
+    sketch_backend: str = "tpu"
+    # Transport/storage backends: "memory" (hermetic, in-process) or the
+    # real services ("pulsar"/"cassandra", import-gated).
+    transport_backend: str = "memory"
+    storage_backend: str = "memory"
+    # Micro-batch size for the processor hot loop. Events are padded to this
+    # size so every device dispatch has a static shape (XLA: one compile).
+    batch_size: int = 8192
+    # Max time to wait filling a batch before flushing a partial one.
+    batch_timeout_s: float = 0.05
+    # Bloom layout: "flat" (standard double-hashed, Redis-parity FPR math)
+    # or "blocked" (512-bit cache blocks, HBM-locality-friendly).
+    bloom_layout: str = "flat"
+    # HLL precision: p=14 -> 16384 registers, matching Redis dense HLL.
+    hll_precision: int = 14
+    # Initial number of HLL banks (one bank per HLL key, grown on demand).
+    hll_initial_banks: int = 8
+    # Sharding: number of sketch shards (hash-prefix partitions) and data-
+    # parallel replicas for multi-chip runs. 1/1 = single chip.
+    num_shards: int = 1
+    num_replicas: int = 1
+    # Snapshot directory for sketch checkpoint/restore ("" = disabled).
+    snapshot_dir: str = ""
+    snapshot_every_batches: int = 0
+
+    def validate(self) -> "Config":
+        if self.sketch_backend not in ("tpu", "memory", "redis"):
+            raise ValueError(f"unknown sketch backend: {self.sketch_backend}")
+        if self.bloom_layout not in ("flat", "blocked"):
+            raise ValueError(f"unknown bloom layout: {self.bloom_layout}")
+        if not (4 <= self.hll_precision <= 18):
+            raise ValueError(f"hll precision out of range: {self.hll_precision}")
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        return self
+
+
+DEFAULT_CONFIG = Config()
+
+
+def add_flags(parser: Optional[argparse.ArgumentParser] = None
+              ) -> argparse.ArgumentParser:
+    """Register framework flags on an argparse parser."""
+    p = parser or argparse.ArgumentParser(description="attendance_tpu")
+    d = DEFAULT_CONFIG
+    p.add_argument("--sketch-backend", choices=["redis", "tpu", "memory"],
+                   default=d.sketch_backend,
+                   help="execution backend for BF.*/PFADD/PFCOUNT")
+    p.add_argument("--transport-backend", choices=["memory", "pulsar"],
+                   default=d.transport_backend)
+    p.add_argument("--storage-backend", choices=["memory", "cassandra"],
+                   default=d.storage_backend)
+    p.add_argument("--pulsar-host", default=d.pulsar_host)
+    p.add_argument("--pulsar-topic", default=d.pulsar_topic)
+    p.add_argument("--redis-host", default=d.redis_host)
+    p.add_argument("--redis-port", type=int, default=d.redis_port)
+    p.add_argument("--cassandra-hosts", default=",".join(d.cassandra_hosts))
+    p.add_argument("--cassandra-keyspace", default=d.cassandra_keyspace)
+    p.add_argument("--bloom-filter-key", default=d.bloom_filter_key)
+    p.add_argument("--bloom-error-rate", type=float,
+                   default=d.bloom_filter_error_rate)
+    p.add_argument("--bloom-capacity", type=int,
+                   default=d.bloom_filter_capacity)
+    p.add_argument("--bloom-layout", choices=["flat", "blocked"],
+                   default=d.bloom_layout)
+    p.add_argument("--hll-key-prefix", default=d.hll_key_prefix)
+    p.add_argument("--hll-precision", type=int, default=d.hll_precision)
+    p.add_argument("--batch-size", type=int, default=d.batch_size)
+    p.add_argument("--batch-timeout-s", type=float, default=d.batch_timeout_s)
+    p.add_argument("--num-shards", type=int, default=d.num_shards)
+    p.add_argument("--num-replicas", type=int, default=d.num_replicas)
+    p.add_argument("--snapshot-dir", default=d.snapshot_dir)
+    p.add_argument("--snapshot-every-batches", type=int,
+                   default=d.snapshot_every_batches)
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    return Config(
+        pulsar_host=args.pulsar_host,
+        pulsar_topic=args.pulsar_topic,
+        redis_host=args.redis_host,
+        redis_port=args.redis_port,
+        cassandra_hosts=args.cassandra_hosts.split(","),
+        cassandra_keyspace=args.cassandra_keyspace,
+        bloom_filter_key=args.bloom_filter_key,
+        bloom_filter_error_rate=args.bloom_error_rate,
+        bloom_filter_capacity=args.bloom_capacity,
+        hll_key_prefix=args.hll_key_prefix,
+        sketch_backend=args.sketch_backend,
+        transport_backend=args.transport_backend,
+        storage_backend=args.storage_backend,
+        batch_size=args.batch_size,
+        batch_timeout_s=args.batch_timeout_s,
+        bloom_layout=args.bloom_layout,
+        hll_precision=args.hll_precision,
+        num_shards=args.num_shards,
+        num_replicas=args.num_replicas,
+        snapshot_dir=args.snapshot_dir,
+        snapshot_every_batches=args.snapshot_every_batches,
+    ).validate()

@@ -1,0 +1,16 @@
+"""Sketch models: the framework's "model family".
+
+The reference has no ML models; its "models" are the probabilistic sketches
+it delegates to RedisBloom (Bloom filter membership, HyperLogLog
+cardinality — SURVEY.md §2.2). Here they are first-class, device-resident
+data structures with batched functional update/query kernels.
+"""
+
+from attendance_tpu.models.bloom import (  # noqa: F401
+    BloomFilter, BloomParams, derive_bloom_params,
+    bloom_init, bloom_add, bloom_contains, bloom_positions,
+)
+from attendance_tpu.models.hll import (  # noqa: F401
+    HyperLogLog, hll_init, hll_add, hll_bucket_rank,
+    hll_histogram, estimate_from_histogram, hll_merge,
+)

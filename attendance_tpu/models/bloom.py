@@ -1,0 +1,211 @@
+"""Device-resident Bloom filter with batched add/contains kernels.
+
+Reference semantics being reimplemented (SURVEY.md §2.2): RedisBloom's
+``BF.RESERVE key error_rate capacity`` / ``BF.ADD`` / ``BF.EXISTS`` — no
+false negatives, false-positive rate <= error_rate at declared capacity.
+Call sites that define the contract: reference attendance_processor.py:78,
+83-88 (reserve), 109-113 (exists) and data_generator.py:59-63 (add).
+
+TPU-first design decisions:
+  * State is a flat ``uint8[m_bits]`` array (one byte per bit) in HBM.
+    Queries are pure gathers + AND-reduction over k probes; updates are
+    idempotent ``scatter-set(1)`` ops, so duplicate keys inside a batch and
+    replayed batches (at-least-once delivery) are harmless — the
+    commutative/idempotent-primitives requirement of SURVEY.md §5.
+  * Sizing follows the standard Bloom math RedisBloom uses:
+    bits_per_entry = -ln(eps)/ln(2)^2, k = ceil(ln(2) * bpe). For
+    eps=0.01 this gives k=7, ~9.59 bits/key.
+  * Two layouts:
+      - "flat": k double-hashed probes over the whole array
+        (h1 + i*h2 mod m, Kirsch–Mitzenmacher) — textbook FPR behavior.
+      - "blocked": each key maps to one 512-bit block; all k probes land
+        inside it. One 64-byte window per key -> HBM-cache friendly and a
+        natural Pallas tile. Blocked filters pay a small FPR penalty, so
+        sizing inflates m by deriving from eps/2 (~+15% bits).
+  * All index math is uint32 (TPUs have no native 64-bit int path);
+    m_bits < 2^31 so scatter/gather indices fit int32.
+
+Scalable ("chained") filters for BF.ADD beyond capacity live in the store
+layer (sketch/), matching RedisBloom's auto-scaling behavior.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from attendance_tpu.ops.murmur3 import (
+    SEED_BLOCK, SEED_BLOOM_A, SEED_BLOOM_B, murmur3_u32)
+
+BLOCK_BITS = 512  # one 64-byte cache block per key in "blocked" layout
+
+_LN2 = math.log(2.0)
+
+
+class BloomParams(NamedTuple):
+    """Static (trace-time) Bloom configuration."""
+    m_bits: int
+    k: int
+    layout: str  # "flat" | "blocked"
+    capacity: int
+    error_rate: float
+
+
+def derive_bloom_params(capacity: int, error_rate: float,
+                        layout: str = "flat") -> BloomParams:
+    """Size the filter the way RedisBloom sizes BF.RESERVE.
+
+    bits_per_entry = -ln(eps) / ln(2)^2 ; k = ceil(ln(2) * bpe).
+    The blocked layout concentrates probes in one 512-bit block which
+    costs accuracy, so it derives its bit budget from eps/2.
+    """
+    if not (0.0 < error_rate < 1.0):
+        raise ValueError(f"error_rate must be in (0,1), got {error_rate}")
+    if capacity <= 0:
+        raise ValueError(f"capacity must be positive, got {capacity}")
+    eff_eps = error_rate / 2.0 if layout == "blocked" else error_rate
+    bpe = -math.log(eff_eps) / (_LN2 * _LN2)
+    k = max(1, math.ceil(_LN2 * bpe))
+    m_bits = math.ceil(capacity * bpe)
+    # Round up to whole 512-bit blocks (required for "blocked", harmless
+    # and tile-friendly for "flat").
+    m_bits = ((m_bits + BLOCK_BITS - 1) // BLOCK_BITS) * BLOCK_BITS
+    if m_bits >= 2 ** 31:
+        raise ValueError(
+            f"filter of {m_bits} bits exceeds int32 indexing; "
+            "shard it instead (attendance_tpu.parallel)")
+    return BloomParams(m_bits=m_bits, k=k, layout=layout,
+                       capacity=capacity, error_rate=error_rate)
+
+
+def bloom_init(params: BloomParams) -> jax.Array:
+    """Fresh all-zero filter state: uint8[m_bits], one byte per bit."""
+    return jnp.zeros((params.m_bits,), dtype=jnp.uint8)
+
+
+def bloom_positions(keys: jax.Array, params: BloomParams) -> jax.Array:
+    """Bit positions probed for each key: uint32[B, k].
+
+    flat:    pos_i = (h1 + i * h2) mod m          (h2 forced odd)
+    blocked: block = h1 mod num_blocks
+             pos_i = block*512 + ((h2 + i * h3) & 511)
+    """
+    keys = jnp.asarray(keys).astype(jnp.uint32)
+    h1 = murmur3_u32(keys, SEED_BLOOM_A)
+    h2 = murmur3_u32(keys, SEED_BLOOM_B) | jnp.uint32(1)
+    i = jnp.arange(params.k, dtype=jnp.uint32)
+    if params.layout == "flat":
+        probes = h1[:, None] + i[None, :] * h2[:, None]
+        return probes % jnp.uint32(params.m_bits)
+    num_blocks = params.m_bits // BLOCK_BITS
+    h3 = murmur3_u32(keys, SEED_BLOCK) | jnp.uint32(1)
+    block = (h1 % jnp.uint32(num_blocks)) * jnp.uint32(BLOCK_BITS)
+    off = (h2[:, None] + i[None, :] * h3[:, None]) & jnp.uint32(BLOCK_BITS - 1)
+    return block[:, None] + off
+
+
+def bloom_positions_np(keys: np.ndarray, params: BloomParams) -> np.ndarray:
+    """Numpy mirror of `bloom_positions` — bit-identical probe positions.
+
+    Backs the host-side "memory" sketch store, which serves as an
+    independent differential oracle for the device path (SURVEY.md §4).
+    """
+    from attendance_tpu.ops.murmur3 import murmur3_u32_np
+    with np.errstate(over="ignore"):
+        keys = np.asarray(keys).astype(np.uint32)
+        h1 = murmur3_u32_np(keys, SEED_BLOOM_A)
+        h2 = murmur3_u32_np(keys, SEED_BLOOM_B) | np.uint32(1)
+        i = np.arange(params.k, dtype=np.uint32)
+        if params.layout == "flat":
+            probes = h1[:, None] + i[None, :] * h2[:, None]
+            return probes % np.uint32(params.m_bits)
+        num_blocks = params.m_bits // BLOCK_BITS
+        h3 = murmur3_u32_np(keys, SEED_BLOCK) | np.uint32(1)
+        block = (h1 % np.uint32(num_blocks)) * np.uint32(BLOCK_BITS)
+        off = ((h2[:, None] + i[None, :] * h3[:, None])
+               & np.uint32(BLOCK_BITS - 1))
+        return block[:, None] + off
+
+
+def bloom_add(bits: jax.Array, keys: jax.Array, params: BloomParams,
+              mask: Optional[jax.Array] = None) -> jax.Array:
+    """Insert a batch of keys; returns the new bit array.
+
+    Masked-out lanes scatter out of bounds and are dropped, so padded
+    batches need no special casing. Scatter-set(1) is idempotent and
+    commutative: duplicates within a batch and replays across batches are
+    safe by construction.
+    """
+    pos = bloom_positions(keys, params).astype(jnp.int32)
+    if mask is not None:
+        pos = jnp.where(mask[:, None], pos, params.m_bits)  # OOB -> dropped
+    return bits.at[pos.reshape(-1)].set(jnp.uint8(1), mode="drop")
+
+
+def bloom_contains(bits: jax.Array, keys: jax.Array,
+                   params: BloomParams) -> jax.Array:
+    """Membership test for a batch of keys: bool[B].
+
+    Gather the k probed bytes per key and AND-reduce. No false negatives;
+    false positives bounded by params.error_rate at declared capacity.
+    """
+    pos = bloom_positions(keys, params).astype(jnp.int32)
+    probes = bits[pos]  # gather: [B, k] uint8
+    return jnp.all(probes == jnp.uint8(1), axis=1)
+
+
+def bloom_fill_fraction(bits: jax.Array) -> jax.Array:
+    """Fraction of set bits (device scalar) — drives the FPR estimate."""
+    return jnp.mean(bits.astype(jnp.float32))
+
+
+class BloomFilter:
+    """Object shell over the functional kernels, holding device state.
+
+    Methods are jit-compiled once per (batch-shape, params) and donate the
+    bit array on update so HBM is reused in place.
+    """
+
+    def __init__(self, capacity: int, error_rate: float,
+                 layout: str = "flat", params: Optional[BloomParams] = None,
+                 bits: Optional[jax.Array] = None):
+        self.params = params or derive_bloom_params(capacity, error_rate,
+                                                    layout)
+        self.bits = bits if bits is not None else bloom_init(self.params)
+        p = self.params
+        self._add = jax.jit(
+            lambda bits, keys, mask: bloom_add(bits, keys, p, mask),
+            donate_argnums=(0,))
+        self._add_nomask = jax.jit(
+            lambda bits, keys: bloom_add(bits, keys, p),
+            donate_argnums=(0,))
+        self._contains = jax.jit(
+            lambda bits, keys: bloom_contains(bits, keys, p))
+
+    @property
+    def num_bits(self) -> int:
+        return self.params.m_bits
+
+    @property
+    def num_hashes(self) -> int:
+        return self.params.k
+
+    def add(self, keys, mask=None) -> None:
+        keys = jnp.asarray(keys, dtype=jnp.uint32)
+        if mask is None:
+            self.bits = self._add_nomask(self.bits, keys)
+        else:
+            self.bits = self._add(self.bits, keys, jnp.asarray(mask))
+
+    def contains(self, keys) -> np.ndarray:
+        keys = jnp.asarray(keys, dtype=jnp.uint32)
+        return np.asarray(self._contains(self.bits, keys))
+
+    def estimated_fpr(self) -> float:
+        """(fill fraction)^k — standard occupancy-based FPR estimate."""
+        fill = float(bloom_fill_fraction(self.bits))
+        return fill ** self.params.k

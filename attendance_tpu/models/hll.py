@@ -1,0 +1,267 @@
+"""Device-resident HyperLogLog banks with batched PFADD/PFCOUNT.
+
+Reference semantics being reimplemented (SURVEY.md §2.2): Redis dense HLL —
+``PFADD key member`` / ``PFCOUNT key...`` with p=14 (16384 six-bit
+registers, ~0.81% standard error). Call sites defining the contract:
+reference attendance_processor.py:127-129 (pfadd per valid event, one HLL
+key per lecture) and attendance_processor.py:151-152 (pfcount).
+
+TPU-first design decisions:
+  * All HLL keys live in ONE device array: ``uint8[num_banks, 2^p]`` —
+    bank b is HLL key b (host keeps the name->bank mapping). A whole
+    micro-batch of PFADDs across many lectures is a single scatter-max,
+    which is commutative/idempotent (safe under duplicates and replay).
+  * The 64-bit hash domain Redis uses is assembled from two independent
+    32-bit MurmurHash3 lanes (TPUs have no native u64): bucket = low p
+    bits of h1; the remaining (64-p)-bit pattern is
+    bits [p..31] of h1 ++ all 32 bits of h2 ++ bits [p..31] of h2's
+    high extension — concretely a (64-p)-bit value split into one uint32
+    word and one (32-p)-bit word. rank = 1 + count-trailing-zeros of
+    that pattern, capped at q+1 = 64-p+1 (= 51 for p=14), exactly the
+    register-value range of Redis dense HLL (fits its 6-bit registers).
+  * PFCOUNT uses Ertl's improved raw estimator (the same estimator Redis
+    adopted for hllCount): no empirical bias tables, accurate from 0 to
+    beyond 2^50 cardinalities. The estimator runs host-side on a 52-bin
+    register histogram computed on device — PFCOUNT is off the hot path.
+  * Merging replicas/shards (PFMERGE, multi-key PFCOUNT) is element-wise
+    register max — the collective used by attendance_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from attendance_tpu.ops.murmur3 import SEED_HLL_HI, SEED_HLL_LO, murmur3_u32
+
+
+def hll_init(num_banks: int, precision: int = 14) -> jax.Array:
+    """Fresh all-zero register banks: uint8[num_banks, 2^precision]."""
+    return jnp.zeros((num_banks, 1 << precision), dtype=jnp.uint8)
+
+
+def _ctz32(x: jax.Array) -> jax.Array:
+    """Count trailing zeros of uint32 lanes (undefined at 0; callers guard)."""
+    lsb = x & (jnp.uint32(0) - x)
+    return jnp.int32(31) - jax.lax.clz(lsb).astype(jnp.int32)
+
+
+def hll_bucket_rank(keys: jax.Array, precision: int = 14):
+    """Per-key (bucket, rank) in the Redis dense-HLL sense.
+
+    bucket: int32[B] in [0, 2^p); rank: int32[B] in [1, 64-p+1].
+    """
+    p = precision
+    q = 64 - p
+    keys = jnp.asarray(keys).astype(jnp.uint32)
+    h1 = murmur3_u32(keys, SEED_HLL_LO)
+    h2 = murmur3_u32(keys, SEED_HLL_HI)
+    bucket = (h1 & jnp.uint32((1 << p) - 1)).astype(jnp.int32)
+    # The (64-p)-bit rank pattern: bits p..31 of h1, then h2.
+    lo = (h1 >> jnp.uint32(p)) | (h2 << jnp.uint32(32 - p))  # 32 bits
+    hi = h2 >> jnp.uint32(p)  # remaining (32-p) bits
+    rank = jnp.where(
+        lo != 0,
+        _ctz32(lo) + 1,
+        jnp.where(hi != 0, jnp.int32(32) + _ctz32(hi) + 1, jnp.int32(q + 1)),
+    )
+    return bucket, rank
+
+
+def hll_bucket_rank_np(keys: np.ndarray, precision: int = 14):
+    """Numpy mirror of `hll_bucket_rank` — bit-identical (bucket, rank).
+
+    Backs the host-side "memory" sketch store (differential oracle for the
+    device path, SURVEY.md §4).
+    """
+    from attendance_tpu.ops.murmur3 import murmur3_u32_np
+    p = precision
+    q = 64 - p
+    with np.errstate(over="ignore"):
+        keys = np.asarray(keys).astype(np.uint32)
+        h1 = murmur3_u32_np(keys, SEED_HLL_LO)
+        h2 = murmur3_u32_np(keys, SEED_HLL_HI)
+        bucket = (h1 & np.uint32((1 << p) - 1)).astype(np.int64)
+        lo = (h1 >> np.uint32(p)) | (h2 << np.uint32(32 - p))
+        hi = h2 >> np.uint32(p)
+
+        def ctz(x):
+            lsb = x & (np.uint32(0) - x)
+            # log2 of a power of two <= 2^31 is exact in float64.
+            safe = np.where(lsb == 0, 1, lsb)
+            return np.log2(safe.astype(np.float64)).astype(np.int64)
+
+        rank = np.where(
+            lo != 0, ctz(lo) + 1,
+            np.where(hi != 0, 32 + ctz(hi) + 1, q + 1))
+    return bucket, rank
+
+
+def hll_add(regs: jax.Array, bank_idx: jax.Array, keys: jax.Array,
+            mask: Optional[jax.Array] = None,
+            precision: int = 14) -> jax.Array:
+    """Batched PFADD: max-merge each key's rank into its bank register.
+
+    bank_idx < 0 or masked-out lanes are dropped (out-of-bounds scatter),
+    so padded/invalid lanes need no special casing.
+    """
+    num_banks, m = regs.shape
+    bucket, rank = hll_bucket_rank(keys, precision)
+    bank_idx = jnp.asarray(bank_idx).astype(jnp.int32)
+    flat = bank_idx * m + bucket
+    keep = bank_idx >= 0
+    if mask is not None:
+        keep = keep & mask
+    flat = jnp.where(keep, flat, num_banks * m)  # OOB -> dropped
+    out = regs.reshape(-1).at[flat].max(rank.astype(jnp.uint8), mode="drop")
+    return out.reshape(num_banks, m)
+
+
+def hll_histogram(regs: jax.Array, precision: int = 14) -> jax.Array:
+    """Register-value histogram per bank: int32[num_banks, q+2]."""
+    q = 64 - precision
+    length = q + 2
+    return jax.vmap(
+        lambda bank: jnp.bincount(bank.astype(jnp.int32), length=length)
+    )(regs)
+
+
+def _sigma(x: float) -> float:
+    """Ertl's sigma: sum used by the linear-counting-range correction."""
+    if x == 1.0:
+        return math.inf
+    y = 1.0
+    z = x
+    while True:
+        x = x * x
+        z_prev = z
+        z += x * y
+        y += y
+        if z == z_prev:
+            return z
+
+
+def _tau(x: float) -> float:
+    """Ertl's tau: correction for saturated (rank > q) registers."""
+    if x == 0.0 or x == 1.0:
+        return 0.0
+    y = 1.0
+    z = 1.0 - x
+    while True:
+        x = math.sqrt(x)
+        z_prev = z
+        y *= 0.5
+        z -= (1.0 - x) ** 2 * y
+        if z == z_prev:
+            return z / 3.0
+
+
+def estimate_from_histogram(hist: np.ndarray, precision: int = 14) -> float:
+    """Ertl improved raw estimator from a register histogram (host-side).
+
+    hist[k] = number of registers whose value is k, k in [0, q+1].
+    """
+    p = precision
+    q = 64 - p
+    m = float(1 << p)
+    C = np.asarray(hist, dtype=np.float64)
+    assert C.shape[-1] == q + 2, f"expected {q + 2} bins, got {C.shape}"
+    z = m * _tau((m - C[q + 1]) / m)
+    for k in range(q, 0, -1):
+        z += C[k]
+        z *= 0.5
+    z += m * _sigma(C[0] / m)
+    alpha_inf = 1.0 / (2.0 * math.log(2.0))
+    if z == 0.0 or math.isinf(z):
+        return 0.0
+    return alpha_inf * m * m / z
+
+
+def hll_merge(a: jax.Array, b: jax.Array) -> jax.Array:
+    """PFMERGE: element-wise register max."""
+    return jnp.maximum(a, b)
+
+
+class HyperLogLog:
+    """Object shell over the functional kernels.
+
+    Holds the device register banks plus the host-side name->bank mapping;
+    grows the bank array by doubling when new HLL keys appear.
+    """
+
+    def __init__(self, initial_banks: int = 8, precision: int = 14):
+        if not (4 <= precision <= 18):
+            raise ValueError(f"precision out of range: {precision}")
+        self.precision = precision
+        self.regs = hll_init(max(1, initial_banks), precision)
+        self._bank_of: dict = {}
+        self._jits: dict = {}
+
+    @property
+    def num_registers(self) -> int:
+        return 1 << self.precision
+
+    def bank_index(self, name: str, create: bool = True) -> int:
+        """Map an HLL key name to its bank row, growing storage on demand."""
+        idx = self._bank_of.get(name)
+        if idx is not None:
+            return idx
+        if not create:
+            return -1
+        idx = len(self._bank_of)
+        if idx >= self.regs.shape[0]:
+            grown = hll_init(self.regs.shape[0] * 2, self.precision)
+            self.regs = grown.at[: self.regs.shape[0]].set(self.regs)
+        self._bank_of[name] = idx
+        return idx
+
+    def _add_fn(self, num_banks: int):
+        fn = self._jits.get(num_banks)
+        if fn is None:
+            prec = self.precision
+            fn = jax.jit(
+                lambda regs, bank_idx, keys, mask: hll_add(
+                    regs, bank_idx, keys, mask, prec),
+                donate_argnums=(0,))
+            self._jits[num_banks] = fn
+        return fn
+
+    def add(self, bank_idx, keys, mask=None) -> None:
+        keys = jnp.asarray(keys, dtype=jnp.uint32)
+        bank_idx = jnp.asarray(bank_idx, dtype=jnp.int32)
+        if mask is None:
+            mask = jnp.ones(keys.shape, dtype=bool)
+        fn = self._add_fn(self.regs.shape[0])
+        self.regs = fn(self.regs, bank_idx, keys, jnp.asarray(mask))
+
+    def add_by_name(self, name: str, keys, mask=None) -> None:
+        idx = self.bank_index(name)
+        bank_idx = jnp.full(jnp.asarray(keys).shape, idx, dtype=jnp.int32)
+        self.add(bank_idx, keys, mask)
+
+    def count(self, name: str) -> int:
+        """PFCOUNT of one HLL key (0 for unknown keys, like Redis)."""
+        idx = self._bank_of.get(name)
+        if idx is None:
+            return 0
+        hist = np.asarray(hll_histogram(self.regs[idx:idx + 1],
+                                        self.precision))[0]
+        return int(round(estimate_from_histogram(hist, self.precision)))
+
+    def count_union(self, names) -> int:
+        """Multi-key PFCOUNT: merge (register max) then estimate."""
+        idxs = [self._bank_of[n] for n in names if n in self._bank_of]
+        if not idxs:
+            return 0
+        merged = self.regs[idxs[0]]
+        for i in idxs[1:]:
+            merged = hll_merge(merged, self.regs[i])
+        hist = np.asarray(hll_histogram(merged[None, :], self.precision))[0]
+        return int(round(estimate_from_histogram(hist, self.precision)))
+
+    def keys(self):
+        return list(self._bank_of)

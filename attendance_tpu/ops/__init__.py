@@ -1,0 +1,1 @@
+"""Device ops: hashing primitives and sketch kernels (XLA + Pallas)."""

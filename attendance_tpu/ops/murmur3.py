@@ -1,0 +1,139 @@
+"""MurmurHash3 (x86 32-bit variant) — batched JAX implementation.
+
+The reference delegates all hashing to Redis/RedisBloom server-side (call
+sites: reference attendance_processor.py:109-113,129 and
+data_generator.py:59-63); this module is the framework's own hash layer,
+vectorized over uint32 key batches so k hash lanes for a whole micro-batch
+are computed on-device in a handful of VPU ops.
+
+Everything is 32-bit: TPUs have no native 64-bit integer path, so wider
+hash domains (e.g. the 64-bit domain the HLL rank extraction needs) are
+assembled from two independent 32-bit hashes with different seeds rather
+than emulating u64 arithmetic.
+
+`murmur3_bytes` is a pure-python reference implementation of the same
+algorithm over byte strings, used (a) to validate the JAX path against
+published test vectors and (b) by the host-side "memory" sketch backend so
+both backends agree bit-for-bit on hash values for integer keys.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_FMIX1 = np.uint32(0x85EBCA6B)
+_FMIX2 = np.uint32(0xC2B2AE35)
+_M5 = np.uint32(5)
+_N = np.uint32(0xE6546B64)
+
+# Distinct well-separated seeds for the independent hash lanes used by the
+# sketches (two lanes for Bloom double hashing, two for the HLL 64-bit
+# domain, one spare for blocked-Bloom intra-block offsets).
+SEED_BLOOM_A = np.uint32(0x9747B28C)
+SEED_BLOOM_B = np.uint32(0x85EBCA6B)
+SEED_BLOCK = np.uint32(0x27D4EB2F)
+SEED_HLL_LO = np.uint32(0xADC83B19)
+SEED_HLL_HI = np.uint32(0x2545F491)
+
+
+def _rotl32(x, r: int):
+    r = np.uint32(r)
+    return (x << r) | (x >> (np.uint32(32) - r))
+
+
+def murmur3_u32(keys, seed) -> jnp.ndarray:
+    """MurmurHash3_x86_32 of each uint32 key (as its 4 little-endian bytes).
+
+    Args:
+      keys: integer array, treated as uint32 (one 4-byte block, no tail).
+      seed: scalar seed (python int or uint32).
+
+    Returns:
+      uint32 array of hashes, same shape as ``keys``.
+    """
+    k = jnp.asarray(keys).astype(jnp.uint32)
+    seed = jnp.uint32(seed)
+    k = k * _C1
+    k = _rotl32(k, 15)
+    k = k * _C2
+    h = seed ^ k
+    h = _rotl32(h, 13)
+    h = h * _M5 + _N
+    h = h ^ jnp.uint32(4)  # total length in bytes
+    # fmix32 finalizer
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * _FMIX1
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * _FMIX2
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def murmur3_bytes(data: bytes, seed: int = 0) -> int:
+    """Pure-python MurmurHash3_x86_32 over bytes (host-side reference)."""
+    mask = 0xFFFFFFFF
+    h = seed & mask
+    n_blocks = len(data) // 4
+    for i in range(n_blocks):
+        (k,) = struct.unpack_from("<I", data, i * 4)
+        k = (k * 0xCC9E2D51) & mask
+        k = ((k << 15) | (k >> 17)) & mask
+        k = (k * 0x1B873593) & mask
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & mask
+        h = (h * 5 + 0xE6546B64) & mask
+    # tail
+    tail = data[n_blocks * 4:]
+    k = 0
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * 0xCC9E2D51) & mask
+        k = ((k << 15) | (k >> 17)) & mask
+        k = (k * 0x1B873593) & mask
+        h ^= k
+    # finalize
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & mask
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & mask
+    h ^= h >> 16
+    return h
+
+
+def murmur3_u32_host(key: int, seed: int) -> int:
+    """Host scalar mirror of `murmur3_u32` (hashes the 4 LE bytes of key)."""
+    return murmur3_bytes(struct.pack("<I", key & 0xFFFFFFFF), seed)
+
+
+def murmur3_u32_np(keys: np.ndarray, seed) -> np.ndarray:
+    """Vectorized numpy mirror of `murmur3_u32` — bit-identical results.
+
+    Used by the host-side "memory" sketch backend so the memory and tpu
+    backends agree on every hash (differential-test oracle, SURVEY.md §4).
+    """
+    with np.errstate(over="ignore"):
+        k = np.asarray(keys).astype(np.uint32)
+        seed = np.uint32(seed)
+        k = k * _C1
+        k = (k << np.uint32(15)) | (k >> np.uint32(17))
+        k = k * _C2
+        h = seed ^ k
+        h = (h << np.uint32(13)) | (h >> np.uint32(19))
+        h = h * _M5 + _N
+        h = h ^ np.uint32(4)
+        h = h ^ (h >> np.uint32(16))
+        h = h * _FMIX1
+        h = h ^ (h >> np.uint32(13))
+        h = h * _FMIX2
+        h = h ^ (h >> np.uint32(16))
+        return h

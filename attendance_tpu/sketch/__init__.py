@@ -1,0 +1,31 @@
+"""Redis-command-compatible sketch store facade.
+
+The reference talks to its sketches exclusively through redis-py call
+shapes — ``execute_command('BF.ADD'|'BF.EXISTS'|'BF.RESERVE', ...)``,
+``pfadd``, ``pfcount`` (reference attendance_processor.py:78,83-88,109-113,
+129,152 and data_generator.py:59-63). This package keeps those call shapes
+API-stable across three interchangeable backends selected by
+``--sketch-backend``:
+
+  * "tpu"    — device-resident sketches, micro-batched JAX kernels
+  * "memory" — pure-host numpy sketches, bit-identical hashing (hermetic
+               tests + differential oracle for the device path)
+  * "redis"  — real Redis Stack via redis-py (import-gated)
+"""
+
+from attendance_tpu.sketch.base import (  # noqa: F401
+    ResponseError, SketchStore, member_to_u32, members_to_u32)
+from attendance_tpu.sketch.memory_store import MemorySketchStore  # noqa: F401
+from attendance_tpu.sketch.tpu_store import TpuSketchStore  # noqa: F401
+
+
+def make_sketch_store(config) -> SketchStore:
+    """Build the sketch store selected by config.sketch_backend."""
+    if config.sketch_backend == "tpu":
+        return TpuSketchStore(config)
+    if config.sketch_backend == "memory":
+        return MemorySketchStore(config)
+    if config.sketch_backend == "redis":
+        from attendance_tpu.sketch.redis_store import RedisSketchStore
+        return RedisSketchStore(config)
+    raise ValueError(f"unknown sketch backend {config.sketch_backend!r}")

@@ -1,0 +1,253 @@
+"""SketchStore interface, member normalization, and scalable-Bloom logic.
+
+Semantics contract (matches Redis Stack behavior at the reference's call
+sites, SURVEY.md §2.2):
+  * BF.EXISTS on a missing key returns 0 (no error).
+  * BF.ADD on a missing key auto-creates a filter with RedisBloom defaults
+    (capacity 100, error 0.01) and auto-scales by chaining sub-filters
+    (expansion x2, halved error) when a sub-filter reaches capacity.
+  * BF.RESERVE on an existing key raises ResponseError("item exists").
+  * PFADD returns 1 iff some register changed; PFCOUNT of a missing key
+    is 0; multi-key PFCOUNT is the union estimate.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from attendance_tpu.models.bloom import BloomParams, derive_bloom_params
+from attendance_tpu.ops.murmur3 import murmur3_bytes
+
+# RedisBloom's defaults for an implicitly-created filter.
+DEFAULT_CAPACITY = 100
+DEFAULT_ERROR_RATE = 0.01
+EXPANSION = 2
+
+_STR_SEED = 0x9E3779B9
+
+
+class ResponseError(Exception):
+    """Command-level error, mirroring redis.exceptions.ResponseError."""
+
+
+def member_to_u32(member: Any) -> int:
+    """Normalize a sketch member to the framework's uint32 key domain.
+
+    Redis hashes the byte-string form of every member, so int 5 and "5"
+    are the same member; we preserve that: integer-valued members (ints or
+    numeric strings) in [0, 2^32) map to their value, everything else maps
+    to a murmur3 digest of its bytes.
+    """
+    if isinstance(member, (bool,)):
+        member = int(member)
+    if isinstance(member, (int, np.integer)):
+        v = int(member)
+        if 0 <= v < 2 ** 32:
+            return v
+        return murmur3_bytes(str(v).encode(), _STR_SEED)
+    if isinstance(member, bytes):
+        data = member
+    else:
+        data = str(member).encode()
+    try:
+        v = int(data)
+        if 0 <= v < 2 ** 32:
+            return v
+    except ValueError:
+        pass
+    return murmur3_bytes(data, _STR_SEED)
+
+
+def members_to_u32(members: Sequence[Any]) -> np.ndarray:
+    """Vector form of member_to_u32; fast-paths integer arrays."""
+    if isinstance(members, np.ndarray) and members.dtype.kind in "iu":
+        return members.astype(np.uint32)
+    return np.array([member_to_u32(x) for x in members], dtype=np.uint32)
+
+
+class ScalableBloom:
+    """RedisBloom-style auto-scaling chain of fixed-size Bloom filters.
+
+    Sub-filter i has capacity c0 * EXPANSION^i and error e0 / 2^i, so the
+    whole chain's FPR stays <= 2*e0. The backend supplies the three
+    per-filter primitives; chaining logic is shared across backends.
+    """
+
+    def __init__(self, store: "SketchStore", capacity: int,
+                 error_rate: float, layout: str):
+        self.store = store
+        self.base_capacity = capacity
+        self.base_error = error_rate
+        self.layout = layout
+        self.filters: List[Any] = []  # backend filter handles
+        self.params: List[BloomParams] = []
+        self.counts: List[int] = []  # approx distinct inserts per filter
+        self._grow()
+
+    def _grow(self) -> None:
+        i = len(self.filters)
+        params = derive_bloom_params(
+            self.base_capacity * (EXPANSION ** i),
+            self.base_error / (2.0 ** i),
+            self.layout)
+        self.filters.append(self.store._filter_create(params))
+        self.params.append(params)
+        self.counts.append(0)
+
+    def contains_many(self, keys: np.ndarray) -> np.ndarray:
+        out = np.zeros(len(keys), dtype=bool)
+        for handle, params in zip(self.filters, self.params):
+            rem = ~out
+            if not rem.any():
+                break
+            out[rem] = self.store._filter_contains(handle, params, keys[rem])
+        return out
+
+    def add_many(self, keys: np.ndarray) -> np.ndarray:
+        """Insert keys; returns per-key 1 if (probably) new, else 0."""
+        existed = self.contains_many(keys)
+        new_keys = keys[~existed]
+        if len(new_keys):
+            if self.counts[-1] + len(new_keys) > self.params[-1].capacity:
+                # Current sub-filter would overflow: chain a bigger one.
+                # (A single batch may still overshoot by < one batch;
+                # the doubled capacity absorbs it.)
+                self._grow()
+            # Distinct inserts, counting within-batch duplicates once.
+            self.counts[-1] += len(np.unique(new_keys))
+            self.filters[-1] = self.store._filter_add(
+                self.filters[-1], self.params[-1], new_keys)
+        return (~existed).astype(np.int64)
+
+    @property
+    def item_count(self) -> int:
+        return sum(self.counts)
+
+    @property
+    def total_capacity(self) -> int:
+        return sum(p.capacity for p in self.params)
+
+
+class SketchStore(abc.ABC):
+    """Abstract sketch store exposing the redis-py call shapes.
+
+    Concrete stores implement the per-filter primitives (_filter_*) and
+    the HLL primitives; the Redis backend overrides the public methods
+    wholesale and never touches the primitives.
+    """
+
+    def __init__(self, config):
+        self.config = config
+        self._blooms: Dict[str, ScalableBloom] = {}
+
+    # -- backend primitives -------------------------------------------------
+    @abc.abstractmethod
+    def _filter_create(self, params: BloomParams):
+        ...
+
+    @abc.abstractmethod
+    def _filter_add(self, handle, params: BloomParams, keys: np.ndarray):
+        """Returns the (possibly replaced) filter handle."""
+
+    @abc.abstractmethod
+    def _filter_contains(self, handle, params: BloomParams,
+                         keys: np.ndarray) -> np.ndarray:
+        ...
+
+    @abc.abstractmethod
+    def _hll_add(self, key: str, keys_u32: np.ndarray,
+                 mask: Optional[np.ndarray] = None) -> int:
+        """Batched PFADD; returns 1 if any register changed."""
+
+    @abc.abstractmethod
+    def _hll_count(self, keys: Sequence[str]) -> int:
+        ...
+
+    # -- Bloom command surface (redis-py execute_command shapes) ------------
+    def bf_reserve(self, key: str, error_rate, capacity) -> bool:
+        if key in self._blooms:
+            raise ResponseError("item exists")
+        self._blooms[key] = ScalableBloom(
+            self, int(capacity), float(error_rate),
+            getattr(self.config, "bloom_layout", "flat"))
+        return True
+
+    def _bloom_or_create(self, key: str) -> ScalableBloom:
+        bloom = self._blooms.get(key)
+        if bloom is None:
+            bloom = ScalableBloom(self, DEFAULT_CAPACITY, DEFAULT_ERROR_RATE,
+                                  getattr(self.config, "bloom_layout", "flat"))
+            self._blooms[key] = bloom
+        return bloom
+
+    def bf_add_many(self, key: str, members) -> np.ndarray:
+        return self._bloom_or_create(key).add_many(members_to_u32(members))
+
+    def bf_exists_many(self, key: str, members) -> np.ndarray:
+        bloom = self._blooms.get(key)
+        u32 = members_to_u32(members)
+        if bloom is None:
+            return np.zeros(len(u32), dtype=bool)
+        return bloom.contains_many(u32)
+
+    # -- HLL command surface ------------------------------------------------
+    def pfadd(self, key: str, *members) -> int:
+        if not members:
+            return 0
+        return self._hll_add(key, members_to_u32(members))
+
+    def pfadd_many(self, key: str, members,
+                   mask: Optional[np.ndarray] = None) -> int:
+        return self._hll_add(key, members_to_u32(members), mask)
+
+    def pfcount(self, *keys: str) -> int:
+        return self._hll_count(keys)
+
+    # -- redis-py compatible entry point ------------------------------------
+    def execute_command(self, *args):
+        """The exact call shape the reference uses for BF.* commands."""
+        if not args:
+            raise ResponseError("empty command")
+        cmd = str(args[0]).upper()
+        if cmd == "BF.RESERVE":
+            _, key, error_rate, capacity = args
+            return self.bf_reserve(str(key), error_rate, capacity)
+        if cmd == "BF.ADD":
+            _, key, member = args
+            return int(self.bf_add_many(str(key), [member])[0])
+        if cmd == "BF.MADD":
+            key = str(args[1])
+            return [int(x) for x in self.bf_add_many(key, list(args[2:]))]
+        if cmd == "BF.EXISTS":
+            _, key, member = args
+            return int(self.bf_exists_many(str(key), [member])[0])
+        if cmd == "BF.MEXISTS":
+            key = str(args[1])
+            return [int(x) for x in self.bf_exists_many(key, list(args[2:]))]
+        if cmd == "BF.INFO":
+            key = str(args[1])
+            bloom = self._blooms.get(key)
+            if bloom is None:
+                raise ResponseError("not found")
+            return {
+                "Capacity": bloom.total_capacity,
+                "Size": sum(p.m_bits // 8 for p in bloom.params),
+                "Number of filters": len(bloom.filters),
+                "Number of items inserted": bloom.item_count,
+                "Expansion rate": EXPANSION,
+            }
+        if cmd == "PFADD":
+            return self.pfadd(str(args[1]), *args[2:])
+        if cmd == "PFCOUNT":
+            return self.pfcount(*[str(k) for k in args[1:]])
+        raise ResponseError(f"unknown command {cmd!r}")
+
+    # -- lifecycle ----------------------------------------------------------
+    def flush(self) -> None:
+        self._blooms.clear()
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,68 @@
+"""Pure-host numpy sketch store.
+
+Bit-identical hashing/layout with the TPU store (shared parameter
+derivation, numpy mirrors of the position/rank math), but zero JAX: the
+hermetic backend for tests and the independent differential oracle for the
+device kernels (SURVEY.md §4 "parity" tier).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from attendance_tpu.models.bloom import BloomParams, bloom_positions_np
+from attendance_tpu.models.hll import (
+    estimate_from_histogram, hll_bucket_rank_np)
+from attendance_tpu.sketch.base import SketchStore
+
+
+class MemorySketchStore(SketchStore):
+    def __init__(self, config):
+        super().__init__(config)
+        self.precision = getattr(config, "hll_precision", 14)
+        self._hll_regs: Dict[str, np.ndarray] = {}
+
+    # -- Bloom primitives ---------------------------------------------------
+    def _filter_create(self, params: BloomParams):
+        return np.zeros(params.m_bits, dtype=np.uint8)
+
+    def _filter_add(self, handle, params: BloomParams, keys: np.ndarray):
+        pos = bloom_positions_np(keys, params)
+        handle[pos.reshape(-1).astype(np.int64)] = 1
+        return handle
+
+    def _filter_contains(self, handle, params: BloomParams,
+                         keys: np.ndarray) -> np.ndarray:
+        pos = bloom_positions_np(keys, params).astype(np.int64)
+        return handle[pos].all(axis=1)
+
+    # -- HLL primitives -----------------------------------------------------
+    def _hll_add(self, key: str, keys_u32: np.ndarray,
+                 mask: Optional[np.ndarray] = None) -> int:
+        regs = self._hll_regs.get(key)
+        if regs is None:
+            regs = self._hll_regs[key] = np.zeros(
+                1 << self.precision, dtype=np.uint8)
+        bucket, rank = hll_bucket_rank_np(keys_u32, self.precision)
+        if mask is not None:
+            rank = np.where(mask, rank, 0)
+        changed = bool((rank > regs[bucket]).any())
+        np.maximum.at(regs, bucket, rank.astype(np.uint8))
+        return int(changed)
+
+    def _hll_count(self, keys: Sequence[str]) -> int:
+        known = [self._hll_regs[k] for k in keys if k in self._hll_regs]
+        if not known:
+            return 0
+        merged = known[0]
+        for r in known[1:]:
+            merged = np.maximum(merged, r)
+        q = 64 - self.precision
+        hist = np.bincount(merged, minlength=q + 2)
+        return int(round(estimate_from_histogram(hist, self.precision)))
+
+    def flush(self) -> None:
+        super().flush()
+        self._hll_regs.clear()

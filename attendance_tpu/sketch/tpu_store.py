@@ -1,0 +1,117 @@
+"""TPU-backed sketch store: device-resident state, batched jitted kernels.
+
+This is the ``--sketch-backend=tpu`` execution backend of the north star:
+the per-event ``BF.EXISTS``/``PFADD`` round-trips of the reference hot loop
+(reference attendance_processor.py:109-129) become gathers/scatters over
+HBM-resident arrays, dispatched once per micro-batch.
+
+Batches are padded to the next power of two (min 8) so XLA compiles a
+bounded set of program shapes; masked lanes scatter out of bounds and are
+dropped by the kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from attendance_tpu.models.bloom import (
+    BloomParams, bloom_add, bloom_contains, bloom_init)
+from attendance_tpu.models.hll import (
+    HyperLogLog, hll_bucket_rank_np)
+from attendance_tpu.sketch.base import SketchStore
+
+
+def pad_to_pow2(n: int, minimum: int = 8) -> int:
+    p = minimum
+    while p < n:
+        p *= 2
+    return p
+
+
+class TpuSketchStore(SketchStore):
+    def __init__(self, config):
+        super().__init__(config)
+        self._hll = HyperLogLog(
+            initial_banks=getattr(config, "hll_initial_banks", 8),
+            precision=getattr(config, "hll_precision", 14))
+        # jit caches keyed by (params, padded batch size)
+        self._add_jits: Dict[Tuple[BloomParams, int], callable] = {}
+        self._contains_jits: Dict[Tuple[BloomParams, int], callable] = {}
+
+    # -- Bloom primitives ---------------------------------------------------
+    def _filter_create(self, params: BloomParams):
+        return bloom_init(params)
+
+    def _pad(self, keys: np.ndarray) -> Tuple[jax.Array, jax.Array, int]:
+        n = len(keys)
+        padded = pad_to_pow2(n)
+        buf = np.zeros(padded, dtype=np.uint32)
+        buf[:n] = keys
+        mask = np.zeros(padded, dtype=bool)
+        mask[:n] = True
+        return jnp.asarray(buf), jnp.asarray(mask), n
+
+    def _filter_add(self, handle, params: BloomParams, keys: np.ndarray):
+        kbuf, mask, _ = self._pad(keys)
+        fn = self._add_jits.get((params, len(kbuf)))
+        if fn is None:
+            fn = jax.jit(lambda bits, k, m: bloom_add(bits, k, params, m),
+                         donate_argnums=(0,))
+            self._add_jits[(params, len(kbuf))] = fn
+        return fn(handle, kbuf, mask)
+
+    def _filter_contains(self, handle, params: BloomParams,
+                         keys: np.ndarray) -> np.ndarray:
+        kbuf, _, n = self._pad(keys)
+        fn = self._contains_jits.get((params, len(kbuf)))
+        if fn is None:
+            fn = jax.jit(lambda bits, k: bloom_contains(bits, k, params))
+            self._contains_jits[(params, len(kbuf))] = fn
+        return np.asarray(fn(handle, kbuf))[:n]
+
+    # -- HLL primitives -----------------------------------------------------
+    def _hll_add(self, key: str, keys_u32: np.ndarray,
+                 mask: Optional[np.ndarray] = None) -> int:
+        idx = self._hll.bank_index(key)
+        # "Did any register change?" computed host-side from the pre-update
+        # row (PFADD's return value; scalar path only, off the hot loop).
+        bucket, rank = hll_bucket_rank_np(keys_u32, self._hll.precision)
+        if mask is not None:
+            rank = np.where(mask, rank, 0)
+        row = np.asarray(self._hll.regs[idx])
+        changed = bool((rank > row[bucket]).any())
+        n = len(keys_u32)
+        padded = pad_to_pow2(n)
+        kbuf = np.zeros(padded, dtype=np.uint32)
+        kbuf[:n] = keys_u32
+        mbuf = np.zeros(padded, dtype=bool)
+        mbuf[:n] = True if mask is None else mask
+        self._hll.add(np.full(padded, idx, dtype=np.int32), kbuf, mbuf)
+        return int(changed)
+
+    def _hll_count(self, keys: Sequence[str]) -> int:
+        known = [k for k in keys if self._hll.bank_index(k, create=False) >= 0]
+        if not known:
+            return 0
+        if len(known) == 1:
+            return self._hll.count(known[0])
+        return self._hll.count_union(known)
+
+    # -- direct state access (used by the fused pipeline + snapshots) -------
+    @property
+    def hll(self) -> HyperLogLog:
+        return self._hll
+
+    def bloom_chain(self, key: str):
+        """The ScalableBloom chain for a key (None if absent)."""
+        return self._blooms.get(key)
+
+    def flush(self) -> None:
+        super().flush()
+        self._hll = HyperLogLog(
+            initial_banks=getattr(self.config, "hll_initial_banks", 8),
+            precision=getattr(self.config, "hll_precision", 14))

@@ -1,0 +1,18 @@
+"""Test harness configuration.
+
+Tests run hermetically on the CPU backend with 8 virtual devices so the
+multi-chip sharding paths (hash-prefix sharded sketches, OR/max
+collectives) are exercised without a TPU pod — SURVEY.md §4. This must run
+before the first `import jax` in any test module, hence env mutation at
+conftest import time (the axon sitecustomize pins JAX_PLATFORMS=axon, so
+we override it here).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

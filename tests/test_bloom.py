@@ -1,0 +1,64 @@
+"""Bloom filter property tests: sizing, no false negatives, FPR budget.
+
+Mirrors the accuracy contract of the reference's BF.RESERVE with
+error_rate=0.01, capacity=100000 (reference attendance_processor.py:83-88).
+"""
+
+import numpy as np
+import pytest
+
+from attendance_tpu.models.bloom import (
+    BloomFilter, derive_bloom_params)
+
+
+def test_sizing_matches_standard_math():
+    p = derive_bloom_params(100_000, 0.01)
+    # -ln(0.01)/ln(2)^2 = 9.585 bits/key -> k = ceil(0.693*9.585) = 7
+    assert p.k == 7
+    assert 9.0 * 100_000 <= p.m_bits <= 10.5 * 100_000
+    assert p.m_bits % 512 == 0
+
+
+@pytest.mark.parametrize("layout", ["flat", "blocked"])
+def test_no_false_negatives(layout):
+    bf = BloomFilter(capacity=20_000, error_rate=0.01, layout=layout)
+    keys = np.arange(10_000, 30_000, dtype=np.uint32)
+    bf.add(keys)
+    assert bf.contains(keys).all()
+
+
+@pytest.mark.parametrize("layout", ["flat", "blocked"])
+def test_fpr_within_budget(layout):
+    cap = 50_000
+    bf = BloomFilter(capacity=cap, error_rate=0.01, layout=layout)
+    members = np.arange(cap, dtype=np.uint32)
+    bf.add(members)
+    non_members = np.arange(1 << 20, (1 << 20) + 200_000, dtype=np.uint32)
+    fp = bf.contains(non_members).mean()
+    # At exactly full capacity the design point is eps=0.01; allow modest
+    # statistical slack on 200k probes.
+    assert fp <= 0.013, fp
+    assert bf.estimated_fpr() <= 0.013
+
+
+def test_masked_add_ignores_padding():
+    bf = BloomFilter(capacity=1_000, error_rate=0.01)
+    keys = np.array([1, 2, 3, 4], dtype=np.uint32)
+    mask = np.array([True, True, False, False])
+    bf.add(keys, mask=mask)
+    got = bf.contains(keys)
+    assert got[0] and got[1]
+    # Masked-out keys were not inserted (could still be FPs, but with a
+    # near-empty 9.6k-bit filter the chance is ~(8/9600)^7 ~ 0).
+    assert not got[2] and not got[3]
+
+
+def test_duplicate_and_replayed_batches_are_idempotent():
+    bf = BloomFilter(capacity=1_000, error_rate=0.01)
+    keys = np.array([7, 7, 7, 42], dtype=np.uint32)
+    bf.add(keys)
+    before = np.asarray(bf.bits).sum()
+    bf.add(keys)  # replay
+    after = np.asarray(bf.bits).sum()
+    assert before == after
+    assert bf.contains(np.array([7, 42], dtype=np.uint32)).all()

@@ -1,0 +1,119 @@
+"""SketchStore facade tests: command surface, scaling, backend parity.
+
+The execute_command shapes under test are exactly the reference's call
+sites (reference attendance_processor.py:78,83-88,109-113,129,152;
+data_generator.py:59-63). The tpu-vs-memory differential tests are the
+framework's stand-in for the redis-vs-tpu parity harness when no Redis
+server is reachable (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from attendance_tpu.config import Config
+from attendance_tpu.sketch import (
+    MemorySketchStore, ResponseError, TpuSketchStore, make_sketch_store)
+
+
+def _stores():
+    cfg = Config(hll_initial_banks=2)
+    return [TpuSketchStore(cfg), MemorySketchStore(cfg)]
+
+
+@pytest.mark.parametrize("store", _stores(), ids=["tpu", "memory"])
+class TestCommandSurface:
+    def test_reference_processor_setup_sequence(self, store):
+        store.flush()
+        # _setup_bloom_filter probe: BF.EXISTS on a missing key -> 0,
+        # then BF.RESERVE, then re-reserve raises (reference
+        # attendance_processor.py:74-92 expects ResponseError semantics).
+        assert store.execute_command("BF.EXISTS", "bf:students", "test") == 0
+        assert store.execute_command("BF.RESERVE", "bf:students", 0.01,
+                                     100_000)
+        with pytest.raises(ResponseError):
+            store.execute_command("BF.RESERVE", "bf:students", 0.01, 100_000)
+
+    def test_add_exists_roundtrip(self, store):
+        store.flush()
+        store.execute_command("BF.RESERVE", "bf", 0.01, 10_000)
+        assert store.execute_command("BF.ADD", "bf", 12345) == 1
+        assert store.execute_command("BF.ADD", "bf", 12345) == 0  # dup
+        assert store.execute_command("BF.EXISTS", "bf", 12345) == 1
+        assert store.execute_command("BF.EXISTS", "bf", "12345") == 1  # str
+        assert store.execute_command("BF.EXISTS", "bf", 99999999) == 0
+
+    def test_madd_mexists(self, store):
+        store.flush()
+        store.execute_command("BF.RESERVE", "bf", 0.01, 10_000)
+        assert store.execute_command("BF.MADD", "bf", 1, 2, 3) == [1, 1, 1]
+        got = store.execute_command("BF.MEXISTS", "bf", 1, 2, 3, 4)
+        assert got[:3] == [1, 1, 1] and got[3] == 0
+
+    def test_add_autocreates_and_scales(self, store):
+        store.flush()
+        # BF.ADD without BF.RESERVE: RedisBloom default capacity 100,
+        # auto-scaling chain growth beyond it; no false negatives ever.
+        keys = np.arange(1000, 2000, dtype=np.uint32)
+        store.bf_add_many("auto", keys)
+        assert store.bf_exists_many("auto", keys).all()
+        info = store.execute_command("BF.INFO", "auto")
+        assert info["Number of filters"] > 1
+        assert info["Number of items inserted"] == 1000
+
+    def test_pfadd_pfcount(self, store):
+        store.flush()
+        assert store.pfcount("hll:unique:LEC1") == 0
+        assert store.pfadd("hll:unique:LEC1", 111) == 1
+        assert store.pfadd("hll:unique:LEC1", 111) == 0  # no change
+        store.pfadd_many("hll:unique:LEC1",
+                         np.arange(500, dtype=np.uint32))
+        est = store.pfcount("hll:unique:LEC1")
+        assert abs(est - 501) <= 15
+        # execute_command spellings too
+        assert store.execute_command("PFADD", "hll:u2", 5) == 1
+        assert store.execute_command("PFCOUNT", "hll:u2") == 1
+
+    def test_pfcount_union(self, store):
+        store.flush()
+        store.pfadd_many("a", np.arange(0, 3000, dtype=np.uint32))
+        store.pfadd_many("b", np.arange(1500, 4500, dtype=np.uint32))
+        est = store.pfcount("a", "b")
+        assert abs(est - 4500) / 4500 < 0.03
+
+    def test_pfadd_mask(self, store):
+        store.flush()
+        keys = np.arange(2000, dtype=np.uint32)
+        store.pfadd_many("m", keys, mask=keys < 700)
+        assert abs(store.pfcount("m") - 700) / 700 < 0.03
+
+
+def test_tpu_memory_differential_bloom():
+    """Backends share hash math -> identical membership answers."""
+    cfg = Config()
+    tpu, mem = TpuSketchStore(cfg), MemorySketchStore(cfg)
+    rng = np.random.default_rng(7)
+    members = rng.integers(0, 2**31, size=20_000, dtype=np.uint32)
+    probes = rng.integers(0, 2**31, size=50_000, dtype=np.uint32)
+    for s in (tpu, mem):
+        s.execute_command("BF.RESERVE", "bf", 0.01, 30_000)
+        s.bf_add_many("bf", members)
+    np.testing.assert_array_equal(
+        tpu.bf_exists_many("bf", probes), mem.bf_exists_many("bf", probes))
+
+
+def test_tpu_memory_differential_hll():
+    cfg = Config()
+    tpu, mem = TpuSketchStore(cfg), MemorySketchStore(cfg)
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 2**32, size=100_000, dtype=np.uint32)
+    for s in (tpu, mem):
+        s.pfadd_many("h", keys)
+    # Same hashes + same estimator -> identical counts, not just close.
+    assert tpu.pfcount("h") == mem.pfcount("h")
+
+
+def test_factory_selects_backend():
+    assert isinstance(make_sketch_store(Config(sketch_backend="tpu")),
+                      TpuSketchStore)
+    assert isinstance(make_sketch_store(Config(sketch_backend="memory")),
+                      MemorySketchStore)
