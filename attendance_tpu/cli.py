@@ -1,0 +1,153 @@
+"""Command-line entry points mirroring the reference's three scripts.
+
+    python -m attendance_tpu.cli generate  [flags]   (data_generator.py)
+    python -m attendance_tpu.cli process   [flags]   (attendance_processor.py)
+    python -m attendance_tpu.cli analyze   [flags]   (attendance_analysis.py)
+    python -m attendance_tpu.cli pipeline  [flags]   (all three, hermetic)
+
+The reference runs its stages as three separate processes connected by
+external services (SURVEY.md §3); with the default memory backends the
+`pipeline` subcommand runs the whole flow in-process (the hermetic
+end-to-end slice of SURVEY.md §7), while `--transport-backend=pulsar`
+etc. reproduce the multi-process deployment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from attendance_tpu.config import add_flags, config_from_args
+
+logging.basicConfig(
+    level=logging.INFO,
+    format="%(asctime)s - %(levelname)s - %(message)s")
+logger = logging.getLogger(__name__)
+
+
+def _add_generate_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--num-students", type=int, default=1000)
+    p.add_argument("--num-invalid", type=int, default=50)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--throttle-s", type=float, default=0.0,
+                   help="per-record sleep (reference behavior: 0.1-0.5)")
+
+
+def cmd_generate(args) -> None:
+    from attendance_tpu.pipeline.generator import generate_student_data
+    from attendance_tpu.sketch import make_sketch_store
+    from attendance_tpu.transport import make_client
+
+    config = config_from_args(args)
+    client = make_client(config)
+    producer = client.create_producer(config.pulsar_topic)
+    sketch = make_sketch_store(config)
+    logger.info("Starting student attendance data generation...")
+    report = generate_student_data(
+        producer=producer, sketch_store=sketch,
+        bloom_key=config.bloom_filter_key,
+        num_students=args.num_students, num_invalid=args.num_invalid,
+        seed=args.seed, throttle_s=args.throttle_s, keep_events=False)
+    logger.info("Generated %d messages (%d invalid attempts)",
+                report.message_count, report.invalid_attempts)
+    client.close()
+
+
+def cmd_process(args) -> None:
+    from attendance_tpu.pipeline.processor import AttendanceProcessor
+
+    config = config_from_args(args)
+    processor = AttendanceProcessor(config)
+    try:
+        processor.process_attendance(
+            max_events=args.max_events,
+            idle_timeout_s=args.idle_timeout_s)
+    finally:
+        m = processor.metrics
+        logger.info(
+            "Processed %d events in %d batches (%.0f ev/s; %d valid, "
+            "%d invalid, %d nacked batches)", m.events, m.batches,
+            m.events_per_second, m.valid_events, m.invalid_events,
+            m.nacked_batches)
+        processor.cleanup()
+
+
+def cmd_analyze(args) -> None:
+    from attendance_tpu.pipeline.analyzer import AttendanceAnalyzer
+    from attendance_tpu.storage import make_event_store
+
+    config = config_from_args(args)
+    store = make_event_store(config)
+    if args.events_file:
+        store.load(args.events_file)
+    analyzer = AttendanceAnalyzer(store)
+    try:
+        analyzer.print_insights(analyzer.generate_insights())
+    finally:
+        analyzer.cleanup()
+
+
+def cmd_pipeline(args) -> None:
+    """Hermetic end-to-end run: generate -> process -> analyze in-process."""
+    from attendance_tpu.pipeline.analyzer import AttendanceAnalyzer
+    from attendance_tpu.pipeline.generator import generate_student_data
+    from attendance_tpu.pipeline.processor import AttendanceProcessor
+
+    config = config_from_args(args)
+    processor = AttendanceProcessor(config)
+    processor.setup_bloom_filter()
+    producer = processor.client.create_producer(config.pulsar_topic)
+    report = generate_student_data(
+        producer=producer, sketch_store=processor.sketch,
+        bloom_key=config.bloom_filter_key,
+        num_students=args.num_students, num_invalid=args.num_invalid,
+        seed=args.seed, keep_events=False)
+    processor.process_attendance(max_events=report.message_count,
+                                 idle_timeout_s=1.0)
+    m = processor.metrics
+    logger.info("Processed %d/%d events (%.0f ev/s)", m.events,
+                report.message_count, m.events_per_second)
+    AttendanceAnalyzer(processor.store).print_insights(
+        AttendanceAnalyzer(processor.store).generate_insights())
+    for lecture_id in processor.store.distinct_lecture_ids():
+        stats = processor.get_attendance_stats(lecture_id)
+        logger.info("%s: %d unique attendees, %d records", lecture_id,
+                    stats["unique_attendees"],
+                    len(stats["attendance_records"]))
+    processor.cleanup()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="attendance_tpu",
+        description="TPU-native real-time attendance framework")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_gen = sub.add_parser("generate", help="produce synthetic swipe events")
+    add_flags(p_gen)
+    _add_generate_flags(p_gen)
+    p_gen.set_defaults(fn=cmd_generate)
+
+    p_proc = sub.add_parser("process", help="run the stream processor")
+    add_flags(p_proc)
+    p_proc.add_argument("--max-events", type=int, default=None)
+    p_proc.add_argument("--idle-timeout-s", type=float, default=None)
+    p_proc.set_defaults(fn=cmd_process)
+
+    p_an = sub.add_parser("analyze", help="batch insights over the store")
+    add_flags(p_an)
+    p_an.add_argument("--events-file", default="",
+                      help="load events from a saved store file first")
+    p_an.set_defaults(fn=cmd_analyze)
+
+    p_pipe = sub.add_parser("pipeline", help="hermetic end-to-end run")
+    add_flags(p_pipe)
+    _add_generate_flags(p_pipe)
+    p_pipe.set_defaults(fn=cmd_pipeline)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,12 @@ class Config:
     # Snapshot directory for sketch checkpoint/restore ("" = disabled).
     snapshot_dir: str = ""
     snapshot_every_batches: int = 0
+    # Poison-message handling: a frame that fails decode/processing is
+    # nacked for redelivery at most this many times, then dead-lettered
+    # (acked + counted). The reference nacks forever (no DLQ despite its
+    # README: SURVEY.md §5 failure detection) which livelocks the
+    # subscription on a poison frame; a bounded retry is strictly safer.
+    max_redeliveries: int = 3
 
     def validate(self) -> "Config":
         if self.sketch_backend not in ("tpu", "memory", "redis"):
@@ -130,6 +136,7 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
     p.add_argument("--snapshot-dir", default=d.snapshot_dir)
     p.add_argument("--snapshot-every-batches", type=int,
                    default=d.snapshot_every_batches)
+    p.add_argument("--max-redeliveries", type=int, default=d.max_redeliveries)
     return p
 
 
@@ -156,4 +163,5 @@ def config_from_args(args: argparse.Namespace) -> Config:
         num_replicas=args.num_replicas,
         snapshot_dir=args.snapshot_dir,
         snapshot_every_batches=args.snapshot_every_batches,
+        max_redeliveries=args.max_redeliveries,
     ).validate()

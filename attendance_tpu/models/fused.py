@@ -1,0 +1,64 @@
+"""The fused hot-loop step: validate (Bloom) + count (HLL) in one dispatch.
+
+This is the framework's "flagship model forward step": the reference's
+3-RTT per-event loop body — BF.EXISTS, conditional PFADD (reference
+attendance_processor.py:109-129) — as a single jitted device program over
+a micro-batch. XLA fuses the hash lanes, the gather/AND membership test
+and the masked scatter-max into one launch; the only host traffic is the
+event batch in and the validity bitmap out.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from attendance_tpu.models.bloom import (
+    BloomParams, bloom_contains, bloom_init, derive_bloom_params)
+from attendance_tpu.models.hll import hll_add, hll_init
+
+
+class SketchState(NamedTuple):
+    """Device-resident state threaded through the fused step."""
+    bloom_bits: jax.Array  # uint8[m_bits]
+    hll_regs: jax.Array    # uint8[num_banks, 2^p]
+
+
+def init_state(capacity: int = 100_000, error_rate: float = 0.01,
+               layout: str = "blocked", num_banks: int = 64,
+               precision: int = 14) -> Tuple[SketchState, BloomParams]:
+    params = derive_bloom_params(capacity, error_rate, layout)
+    return SketchState(bloom_init(params),
+                       hll_init(num_banks, precision)), params
+
+
+def fused_step(state: SketchState, keys: jax.Array, bank_idx: jax.Array,
+               mask: jax.Array, params: BloomParams,
+               precision: int = 14) -> Tuple[SketchState, jax.Array]:
+    """One micro-batch through the hot loop.
+
+    keys:     uint32[B] student ids
+    bank_idx: int32[B] HLL bank (lecture) per event
+    mask:     bool[B]  real-event lanes (padding = False)
+
+    Returns (new_state, valid[B]): valid is the recomputed Bloom
+    membership; only valid & unpadded events reach the HLL registers
+    (reference semantics: PFADD iff BF.EXISTS,
+    attendance_processor.py:127-129).
+    """
+    valid = bloom_contains(state.bloom_bits, keys, params)
+    regs = hll_add(state.hll_regs,
+                   jnp.where(valid & mask, bank_idx, -1),
+                   keys, precision=precision)
+    return SketchState(state.bloom_bits, regs), valid
+
+
+def make_jitted_step(params: BloomParams, precision: int = 14,
+                     donate: bool = True):
+    """jit-compile fused_step for fixed params (one compile per batch
+    shape; state donated so HBM is updated in place)."""
+    fn = lambda state, keys, bank_idx, mask: fused_step(
+        state, keys, bank_idx, mask, params, precision)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())

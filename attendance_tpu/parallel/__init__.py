@@ -1,0 +1,22 @@
+"""Multi-chip sharding for the sketch state (SURVEY.md §2.3).
+
+The reference's only scale-out axis is Pulsar competing consumers; its
+sketch state is one Redis instance. Here the two first-class axes are:
+
+  * "dp" (data parallel)   — micro-batches split across chips; sketch
+    state replicated, kept consistent with a bitwise-OR (Bloom) /
+    element-wise-max (HLL) allreduce after each update — the TPU-native
+    replacement for "many consumers, one Redis".
+  * "sp" (sketch parallel) — sketch state partitioned across chips:
+    Bloom blocks / HLL register ranges by hash prefix, so 10M+-student
+    rosters exceed single-chip HBM. Updates touch only the owning shard;
+    queries combine per-shard partial answers with tiny boolean/int
+    collectives over ICI.
+
+Everything is expressed with `jax.shard_map` over a `jax.sharding.Mesh`,
+so XLA lays the collectives on ICI; tests exercise an 8-device CPU mesh
+(SURVEY.md §4) and the same code path scales to real pods.
+"""
+
+from attendance_tpu.parallel.sharded import (  # noqa: F401
+    ShardedSketchEngine, make_mesh)

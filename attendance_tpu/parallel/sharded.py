@@ -1,0 +1,232 @@
+"""Sharded sketch engine: shard_map kernels over a (dp, sp) device mesh.
+
+State layout (per SURVEY.md §2.3 "hash-prefix sharding"):
+  * Bloom bit array  uint8[m_bits]        — axis 0 split across "sp",
+                                            replicated across "dp".
+  * HLL banks        uint8[banks, m_regs] — register axis split across
+                                            "sp", replicated across "dp".
+  * Event batch      uint32[B] keys (+ int32[B] bank ids)
+                                          — split across "dp",
+                                            replicated across "sp".
+
+Per-device kernels operate on *global* hash positions translated into the
+local slice; probes/updates outside the slice are neutral (AND-identity)
+or dropped (scatter OOB). Cross-device combination is exactly the two
+collectives the design calls for (SURVEY.md §5 "distributed communication
+backend"):
+
+  * query:  AND across "sp" (each shard answers for the probes it owns),
+            implemented as a min-reduce; counts via histogram psum.
+  * update: OR across "dp" for Bloom (max-reduce over {0,1} bytes) and
+            register-max across "dp" for HLL, so every replica converges
+            to the union state after each batch.
+
+With the "blocked" Bloom layout every key's k probes live in one 512-bit
+block, so exactly one "sp" shard does real work per key — the gather
+traffic stays local and only the 1-byte-per-key answer rides ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from attendance_tpu.models.bloom import (
+    BLOCK_BITS, BloomParams, bloom_positions, derive_bloom_params)
+from attendance_tpu.models.hll import (
+    estimate_from_histogram, hll_bucket_rank)
+
+
+def make_mesh(num_shards: int = 1, num_replicas: int = 1,
+              devices=None) -> Mesh:
+    """A (dp=num_replicas, sp=num_shards) mesh over the given devices."""
+    devices = devices if devices is not None else jax.devices()
+    need = num_shards * num_replicas
+    if len(devices) < need:
+        raise ValueError(
+            f"need {need} devices for dp={num_replicas} x sp={num_shards}, "
+            f"have {len(devices)}")
+    dev = np.asarray(devices[:need]).reshape(num_replicas, num_shards)
+    return Mesh(dev, axis_names=("dp", "sp"))
+
+
+class ShardedSketchEngine:
+    """Device-mesh-resident Bloom + HLL with fused update/query steps.
+
+    The multi-chip counterpart of TpuSketchStore's single-chip state: one
+    Bloom filter (the student roster) and a fixed-size array of HLL banks
+    (one per lecture key), all sharded as described in the module
+    docstring. Batch entry points take fixed-shape arrays; callers pad and
+    mask (static shapes keep XLA to one compile per batch size).
+    """
+
+    def __init__(self, mesh: Mesh, capacity: int, error_rate: float,
+                 num_banks: int = 64, precision: int = 14,
+                 layout: str = "blocked",
+                 params: Optional[BloomParams] = None):
+        self.mesh = mesh
+        self.sp = mesh.shape["sp"]
+        self.dp = mesh.shape["dp"]
+        self.precision = precision
+        self.params = params or derive_bloom_params(
+            capacity, error_rate, layout)
+        # m_bits must split evenly into sp slices of whole blocks.
+        chunk = self.sp * BLOCK_BITS
+        m = ((self.params.m_bits + chunk - 1) // chunk) * chunk
+        if m != self.params.m_bits:
+            self.params = self.params._replace(m_bits=m)
+        self.m_regs = 1 << precision
+        if self.m_regs % self.sp:
+            raise ValueError(f"sp={self.sp} must divide {self.m_regs}")
+        self.num_banks = num_banks
+
+        bits_sharding = NamedSharding(mesh, P("sp"))
+        regs_sharding = NamedSharding(mesh, P(None, "sp"))
+        self.bits = jax.device_put(
+            jnp.zeros((self.params.m_bits,), jnp.uint8), bits_sharding)
+        self.regs = jax.device_put(
+            jnp.zeros((num_banks, self.m_regs), jnp.uint8), regs_sharding)
+        self._build_kernels()
+
+    # -- shard_map kernels --------------------------------------------------
+    def _build_kernels(self) -> None:
+        mesh = self.mesh
+        params = self.params
+        precision = self.precision
+        m_local = params.m_bits // self.sp
+        regs_local = self.m_regs // self.sp
+
+        def local_contains(bits_loc, keys):
+            """Per-device partial membership: AND over the probes whose
+            global position falls in this device's slice (True elsewhere:
+            the AND-identity)."""
+            pos = bloom_positions(keys, params).astype(jnp.int32)
+            lo = jax.lax.axis_index("sp").astype(jnp.int32) * m_local
+            rel = pos - lo
+            in_range = (rel >= 0) & (rel < m_local)
+            probes = jnp.where(
+                in_range, bits_loc[jnp.clip(rel, 0, m_local - 1)],
+                jnp.uint8(1))
+            return jnp.all(probes == jnp.uint8(1), axis=1)
+
+        def bloom_add_kernel(bits_loc, keys, mask):
+            pos = bloom_positions(keys, params).astype(jnp.int32)
+            lo = jax.lax.axis_index("sp").astype(jnp.int32) * m_local
+            rel = pos - lo
+            keep = (rel >= 0) & (rel < m_local) & mask[:, None]
+            rel = jnp.where(keep, rel, m_local)  # OOB -> dropped
+            bits_loc = bits_loc.at[rel.reshape(-1)].set(
+                jnp.uint8(1), mode="drop")
+            # OR-allreduce across replicas (bytes are {0,1} so max == or).
+            return jax.lax.pmax(bits_loc, "dp")
+
+        def hll_add_local(regs_loc, bank_idx, keys, mask):
+            bucket, rank = hll_bucket_rank(keys, precision)
+            lo = jax.lax.axis_index("sp").astype(jnp.int32) * regs_local
+            rel = bucket - lo
+            keep = (rel >= 0) & (rel < regs_local) & (bank_idx >= 0) & mask
+            flat = jnp.where(keep, bank_idx * regs_local + rel,
+                             regs_loc.size)
+            out = regs_loc.reshape(-1).at[flat].max(
+                rank.astype(jnp.uint8), mode="drop")
+            # register-max allreduce across replicas.
+            return jax.lax.pmax(out.reshape(regs_loc.shape), "dp")
+
+        def step_kernel(bits_loc, regs_loc, keys, bank_idx, mask):
+            """Fused hot-loop step on one device: validate the local batch
+            slice against the sharded Bloom, then count the valid events
+            into the sharded HLL banks."""
+            partial = local_contains(bits_loc, keys)
+            # AND across sp: min-reduce of {0,1}.
+            valid = jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
+            new_regs = hll_add_local(
+                regs_loc, jnp.where(valid, bank_idx, -1), keys, mask)
+            return valid, new_regs
+
+        def query_kernel(bits_loc, keys):
+            partial = local_contains(bits_loc, keys)
+            return jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
+
+        def hist_kernel(regs_loc):
+            """Full register histogram per bank: psum of per-slice
+            histograms across sp."""
+            q = 64 - precision
+            hist = jax.vmap(lambda bank: jnp.bincount(
+                bank.astype(jnp.int32), length=q + 2))(regs_loc)
+            return jax.lax.psum(hist, "sp")
+
+        smap = functools.partial(jax.shard_map, mesh=mesh)
+        self._preload = jax.jit(smap(
+            bloom_add_kernel,
+            in_specs=(P("sp"), P("dp"), P("dp")),
+            out_specs=P("sp")),
+            donate_argnums=(0,))
+        self._step = jax.jit(smap(
+            step_kernel,
+            in_specs=(P("sp"), P(None, "sp"), P("dp"), P("dp"), P("dp")),
+            out_specs=(P("dp"), P(None, "sp"))),
+            donate_argnums=(1,))
+        self._query = jax.jit(smap(
+            query_kernel, in_specs=(P("sp"), P("dp")), out_specs=P("dp")))
+        self._hist = jax.jit(smap(
+            hist_kernel, in_specs=(P(None, "sp"),), out_specs=P(None)))
+
+    # -- padded batch helpers ------------------------------------------------
+    def _pad(self, arr: np.ndarray, fill, dtype) -> Tuple[np.ndarray, int]:
+        # Pad to the next power of two (min 256), then up to a multiple of
+        # dp so the batch axis splits evenly across replicas even when dp
+        # is not a power of two (e.g. a 6-device dp=3 x sp=2 mesh). The
+        # set of compiled shapes stays bounded: one per power of two.
+        n = len(arr)
+        padded = 256
+        while padded < n:
+            padded *= 2
+        padded = ((padded + self.dp - 1) // self.dp) * self.dp
+        buf = np.full(padded, fill, dtype=dtype)
+        buf[:n] = arr
+        return buf, n
+
+    # -- public API ----------------------------------------------------------
+    def preload(self, keys) -> None:
+        """Batched BF.ADD of the roster into the sharded filter."""
+        keys = np.asarray(keys, dtype=np.uint32)
+        kbuf, n = self._pad(keys, 0, np.uint32)
+        mask = np.zeros(len(kbuf), dtype=bool)
+        mask[:n] = True
+        self.bits = self._preload(self.bits, jnp.asarray(kbuf),
+                                  jnp.asarray(mask))
+
+    def step(self, keys, bank_idx) -> np.ndarray:
+        """Fused validate+count for one micro-batch; returns validity[B]."""
+        keys = np.asarray(keys, dtype=np.uint32)
+        bank_idx = np.asarray(bank_idx, dtype=np.int32)
+        kbuf, n = self._pad(keys, 0, np.uint32)
+        bbuf, _ = self._pad(bank_idx, -1, np.int32)
+        mask = np.zeros(len(kbuf), dtype=bool)
+        mask[:n] = True
+        valid, self.regs = self._step(self.bits, self.regs,
+                                      jnp.asarray(kbuf), jnp.asarray(bbuf),
+                                      jnp.asarray(mask))
+        return np.asarray(valid)[:n]
+
+    def contains(self, keys) -> np.ndarray:
+        keys = np.asarray(keys, dtype=np.uint32)
+        kbuf, n = self._pad(keys, 0, np.uint32)
+        return np.asarray(self._query(self.bits, jnp.asarray(kbuf)))[:n]
+
+    def count(self, bank: int) -> int:
+        """PFCOUNT of one bank (Ertl estimator over the psum'd histogram)."""
+        hist = np.asarray(self._hist(self.regs))[bank]
+        return int(round(estimate_from_histogram(hist, self.precision)))
+
+    def count_all(self) -> np.ndarray:
+        """PFCOUNT of every bank in one device pass."""
+        hists = np.asarray(self._hist(self.regs))
+        return np.array([
+            int(round(estimate_from_histogram(h, self.precision)))
+            for h in hists])

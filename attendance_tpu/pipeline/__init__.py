@@ -1,0 +1,18 @@
+"""Pipeline layer: generator -> micro-batched processor -> analyzer.
+
+TPU-native rebuild of the reference's three entry points (SURVEY.md §1
+L3-L5). The event schema and the per-stage behavior follow the reference
+CODE (not its README — SURVEY.md §0.3): events are
+``{student_id, timestamp, lecture_id, is_valid, event_type}``; the
+processor recomputes validity via the Bloom filter and ignores the
+generator's ground-truth flag (which the tests use as their end-to-end
+oracle, SURVEY.md §4).
+"""
+
+from attendance_tpu.pipeline.events import (  # noqa: F401
+    AttendanceEvent, decode_event, decode_event_batch, encode_event,
+    encode_event_binary, decode_binary_batch, BINARY_MAGIC)
+from attendance_tpu.pipeline.generator import (  # noqa: F401
+    GeneratorReport, generate_student_data)
+from attendance_tpu.pipeline.processor import AttendanceProcessor  # noqa: F401
+from attendance_tpu.pipeline.analyzer import AttendanceAnalyzer  # noqa: F401

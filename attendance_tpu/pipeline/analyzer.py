@@ -1,0 +1,128 @@
+"""Batch analytics: the reference's five insights over the event store.
+
+Rebuilds `AttendanceAnalyzer` (reference attendance_analysis.py:14-146)
+against the framework's storage layer: fetch all rows (the reference's
+DISTINCT-lectures + per-lecture ALLOW FILTERING scans, reference
+attendance_analysis.py:19-52, collapse to the store's scan API), then the
+same five pandas aggregations (reference attendance_analysis.py:65-118):
+
+  1. habitual latecomers        (hour >= 9, above-median count per student)
+  2. attendance by day-of-week
+  3. lecture rankings           (top-3 / bottom-3 by event count)
+  4. consistency                (count > median + std per student)
+  5. invalid attempts per student
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List
+
+import pandas as pd
+
+logger = logging.getLogger(__name__)
+
+LATE_THRESHOLD_HOUR = 9  # 9 AM, reference attendance_analysis.py:67
+
+
+class AttendanceAnalyzer:
+    def __init__(self, event_store):
+        self.store = event_store
+
+    def _fetch_attendance_data(self) -> pd.DataFrame:
+        rows = self.store.scan_all()
+        if not rows:
+            logger.warning("No attendance records found")
+            return pd.DataFrame()
+        return pd.DataFrame({
+            "student_id": [r.student_id for r in rows],
+            "lecture_id": [r.lecture_id for r in rows],
+            "timestamp": [r.timestamp for r in rows],
+            "is_valid": [r.is_valid for r in rows],
+        })
+
+    def generate_insights(self) -> List[Dict]:
+        logger.info("Generating attendance insights...")
+        df = self._fetch_attendance_data()
+        if df.empty:
+            logger.warning("No attendance data found")
+            return []
+
+        insights = []
+        ts = pd.to_datetime(df["timestamp"])
+
+        # 1. Habitual latecomers
+        late = df[ts.dt.hour >= LATE_THRESHOLD_HOUR].groupby(
+            "student_id").size()
+        frequent_late = late[late > late.median()]
+        insights.append({
+            "title": "Habitual Latecomers",
+            "description": (
+                f"Found {len(frequent_late)} students who frequently arrive "
+                f"after {LATE_THRESHOLD_HOUR}:00 AM"),
+            "data": frequent_late.to_dict(),
+        })
+
+        # 2. Attendance patterns by day of week
+        day_patterns = df.groupby(ts.dt.day_name()).size()
+        insights.append({
+            "title": "Attendance by Day",
+            "description": "Distribution of attendance across different days",
+            "data": day_patterns.to_dict(),
+        })
+
+        # 3. Most and least attended lectures
+        ranking = df.groupby("lecture_id").size().sort_values(
+            ascending=False)
+        insights.append({
+            "title": "Lecture Attendance Rankings",
+            "description": "Most and least attended lectures",
+            "data": {
+                "most_attended": ranking.head(3).to_dict(),
+                "least_attended": ranking.tail(3).to_dict(),
+            },
+        })
+
+        # 4. Consistency analysis
+        counts = df.groupby("student_id").size()
+        consistent = counts[counts > counts.median() + counts.std()]
+        insights.append({
+            "title": "Most Consistent Attendees",
+            "description": "Students with above-average attendance",
+            "data": consistent.to_dict(),
+        })
+
+        # 5. Invalid attendance attempts
+        invalid = df[~df["is_valid"]].groupby("student_id").size()
+        insights.append({
+            "title": "Invalid Attendance Attempts",
+            "description": "Number of invalid attendance attempts by "
+                           "student ID",
+            "data": invalid.to_dict() if not invalid.empty else {},
+        })
+
+        return insights
+
+    def print_insights(self, insights: List[Dict]) -> None:
+        """Formatted console dump (reference attendance_analysis.py:122-142)."""
+        if not insights:
+            print("\nNo insights available - no attendance data found.")
+            return
+        for insight in insights:
+            print(f"\n=== {insight['title']} ===")
+            print(insight["description"])
+            print("Data:")
+            if isinstance(insight["data"], dict) and insight["data"]:
+                for key, value in insight["data"].items():
+                    if isinstance(value, dict):
+                        print(f"\n{key}:")
+                        for k, v in value.items():
+                            print(f"  {k}: {v}")
+                    else:
+                        print(f"{key}: {value}")
+            else:
+                print("No data available")
+            print("-" * 50)
+
+    def cleanup(self) -> None:
+        self.store.close()

@@ -1,0 +1,160 @@
+"""Event schema + wire codecs (JSON parity path and fast binary path).
+
+Schema ground truth is the reference generator's emitted dicts
+(reference data_generator.py:112-118,126-132,142-148):
+``{student_id:int, timestamp:iso-str, lecture_id:"LECTURE_YYYYMMDD",
+is_valid:bool, event_type:"entry"|"exit"}`` — NOT the README's divergent
+schema (SURVEY.md §0.3 item 1).
+
+Two codecs:
+  * JSON — byte-compatible with the reference's ``json.dumps(...).encode()``
+    producer frames; the parity ingress.
+  * Binary — fixed 20-byte little-endian records decoded with one
+    ``np.frombuffer`` per batch. At the north-star rate (50M ev/s)
+    per-event ``json.loads`` on the host is the bottleneck (SURVEY.md §7
+    hard part d); the binary path turns a batch of frames into the four
+    column arrays the device kernels consume with zero per-event Python.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import dataclass
+from datetime import datetime
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+EVENT_ENTRY = 0
+EVENT_EXIT = 1
+_EVENT_NAMES = ("entry", "exit")
+
+# Binary record layout (20 bytes): u32 student_id | u32 lecture_yyyymmdd |
+# i64 unix_micros | u8 flags | 3 pad. A numpy structured dtype so a whole
+# frame decodes with a single np.frombuffer.
+BINARY_DTYPE = np.dtype([
+    ("student_id", "<u4"),
+    ("lecture_day", "<u4"),   # yyyymmdd as an integer
+    ("micros", "<i8"),        # unix epoch microseconds
+    ("flags", "<u1"),         # bit0 = is_valid, bit1 = event_type(exit)
+    ("pad", "V3"),
+])
+assert BINARY_DTYPE.itemsize == 20
+
+BINARY_MAGIC = b"ATB1"  # frame prefix distinguishing binary from JSON ('{')
+
+
+@dataclass
+class AttendanceEvent:
+    student_id: int
+    timestamp: str  # ISO-8601, as the reference emits
+    lecture_id: str
+    is_valid: bool
+    event_type: str
+
+    def to_dict(self) -> Dict:
+        return {
+            "student_id": self.student_id,
+            "timestamp": self.timestamp,
+            "lecture_id": self.lecture_id,
+            "is_valid": self.is_valid,
+            "event_type": self.event_type,
+        }
+
+
+def encode_event(event: AttendanceEvent) -> bytes:
+    """The reference's wire format: json.dumps(dict).encode('utf-8')."""
+    return json.dumps(event.to_dict()).encode("utf-8")
+
+
+def decode_event(data: bytes) -> AttendanceEvent:
+    d = json.loads(data.decode("utf-8"))
+    return AttendanceEvent(
+        student_id=int(d["student_id"]),
+        timestamp=str(d["timestamp"]),
+        lecture_id=str(d["lecture_id"]),
+        is_valid=bool(d.get("is_valid", True)),
+        event_type=str(d.get("event_type", "entry")),
+    )
+
+
+def decode_event_batch(frames: Sequence[bytes]) -> List[AttendanceEvent]:
+    return [decode_event(f) for f in frames]
+
+
+# ---------------------------------------------------------------------------
+# Binary fast path
+# ---------------------------------------------------------------------------
+
+def _iso_to_micros(ts: str) -> int:
+    return int(datetime.fromisoformat(ts).timestamp() * 1e6)
+
+
+def _lecture_to_day(lecture_id: str) -> int:
+    # "LECTURE_YYYYMMDD" -> yyyymmdd; non-conforming ids hash to a stable
+    # bucket above any calendar value so they stay distinct from real
+    # days. murmur3 (not builtin hash) so the mapping survives process
+    # restarts — PYTHONHASHSEED salts str hashes per interpreter.
+    tail = lecture_id.rsplit("_", 1)[-1]
+    if tail.isdigit() and len(tail) == 8:
+        return int(tail)
+    from attendance_tpu.ops.murmur3 import murmur3_bytes
+    return 100_000_000 + (murmur3_bytes(lecture_id.encode(), 0) & 0x3FFFFFF)
+
+
+def encode_event_binary(event: AttendanceEvent) -> bytes:
+    rec = np.zeros(1, dtype=BINARY_DTYPE)
+    rec["student_id"] = event.student_id & 0xFFFFFFFF
+    rec["lecture_day"] = _lecture_to_day(event.lecture_id)
+    rec["micros"] = _iso_to_micros(event.timestamp)
+    flags = (1 if event.is_valid else 0)
+    if event.event_type == "exit":
+        flags |= 2
+    rec["flags"] = flags
+    return BINARY_MAGIC + rec.tobytes()
+
+
+def encode_binary_batch(events: Sequence[AttendanceEvent]) -> bytes:
+    """One frame holding N records (bulk transport for the bench path)."""
+    rec = np.zeros(len(events), dtype=BINARY_DTYPE)
+    for i, e in enumerate(events):
+        rec["student_id"][i] = e.student_id & 0xFFFFFFFF
+        rec["lecture_day"][i] = _lecture_to_day(e.lecture_id)
+        rec["micros"][i] = _iso_to_micros(e.timestamp)
+        rec["flags"][i] = ((1 if e.is_valid else 0)
+                           | (2 if e.event_type == "exit" else 0))
+    return BINARY_MAGIC + rec.tobytes()
+
+
+def decode_binary_batch(data: bytes) -> Dict[str, np.ndarray]:
+    """Zero-copy columnar decode of one binary frame -> column arrays."""
+    if not data.startswith(BINARY_MAGIC):
+        raise ValueError("not a binary event frame")
+    rec = np.frombuffer(data, dtype=BINARY_DTYPE, offset=len(BINARY_MAGIC))
+    return {
+        "student_id": rec["student_id"],
+        "lecture_day": rec["lecture_day"],
+        "micros": rec["micros"],
+        "is_valid": (rec["flags"] & 1).astype(bool),
+        "event_type": ((rec["flags"] >> 1) & 1).astype(np.int8),
+    }
+
+
+def columns_from_events(events: Sequence[AttendanceEvent]
+                        ) -> Dict[str, np.ndarray]:
+    """Columnar view of decoded JSON events (the shape the kernels eat)."""
+    n = len(events)
+    student = np.empty(n, dtype=np.uint32)
+    day = np.empty(n, dtype=np.uint32)
+    micros = np.empty(n, dtype=np.int64)
+    flags_valid = np.empty(n, dtype=bool)
+    etype = np.empty(n, dtype=np.int8)
+    for i, e in enumerate(events):
+        student[i] = e.student_id & 0xFFFFFFFF
+        day[i] = _lecture_to_day(e.lecture_id)
+        micros[i] = _iso_to_micros(e.timestamp)
+        flags_valid[i] = e.is_valid
+        etype[i] = EVENT_EXIT if e.event_type == "exit" else EVENT_ENTRY
+    return {"student_id": student, "lecture_day": day, "micros": micros,
+            "is_valid": flags_valid, "event_type": etype}

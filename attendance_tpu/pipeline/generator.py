@@ -1,0 +1,140 @@
+"""Synthetic RFID-swipe load generator (reference-parity behavior).
+
+Reimplements the reference generator's statistical behavior (reference
+data_generator.py:38-193): 1000 unique valid student IDs in [10000, 99999]
+preloaded into the Bloom filter, 50 invalid IDs in [100000, 999999]; per
+student an 80% punctuality draw (punctual entry hour 8-9, late 9-11), 3-7
+attendance days sampled from the past week, an entry+exit event pair per
+attended day (exit 3-4h later), a 15%-chance invalid attempt per day, and
+20 standalone invalid attempts at the end. Every event carries the
+generator's ground-truth ``is_valid`` flag that the processor ignores and
+recomputes — the end-to-end test oracle (SURVEY.md §4).
+
+Differences from the reference (deliberate, TPU-first):
+  * No per-record ``time.sleep`` throttle by default — the reference
+    sleeps 0.1-0.5s per day-iteration (reference data_generator.py:159,185)
+    capping it at ~4-30 ev/s; ``throttle_s`` restores that behavior.
+  * Seedable RNG for reproducible tests.
+  * Bloom preload goes through one batched ``BF.MADD``-style call instead
+    of 1000 sequential round-trips (reference data_generator.py:57-64).
+  * Scalable population: ``num_students``/``num_invalid`` default to the
+    reference's 1000/50 but scale to millions for the bench rig.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from dataclasses import dataclass, field
+from datetime import datetime, timedelta
+from typing import List, Optional, Set
+
+from attendance_tpu.pipeline.events import AttendanceEvent, encode_event
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class GeneratorReport:
+    """What was generated — the ground truth the tests assert against."""
+    valid_student_ids: Set[int] = field(default_factory=set)
+    invalid_student_ids: Set[int] = field(default_factory=set)
+    message_count: int = 0
+    invalid_attempts: int = 0
+    events: List[AttendanceEvent] = field(default_factory=list)
+
+
+def _sample_unique_ids(rng: random.Random, lo: int, hi: int,
+                       n: int) -> Set[int]:
+    """n distinct ints in [lo, hi] (the faker.unique.random_int contract,
+    reference data_generator.py:53-54,80-81)."""
+    if hi - lo + 1 < n:
+        raise ValueError("population smaller than requested sample")
+    return set(rng.sample(range(lo, hi + 1), n))
+
+
+def generate_student_data(
+        producer=None,
+        sketch_store=None,
+        bloom_key: str = "bf:students",
+        num_students: int = 1000,
+        num_invalid: int = 50,
+        standalone_invalid: int = 20,
+        now: Optional[datetime] = None,
+        seed: Optional[int] = None,
+        throttle_s: float = 0.0,
+        keep_events: bool = True) -> GeneratorReport:
+    """Generate the reference's event mix; returns the ground-truth report.
+
+    producer: transport producer with .send(bytes) (None = don't publish).
+    sketch_store: SketchStore for the Bloom preload (None = skip preload).
+    """
+    rng = random.Random(seed)
+    now = now or datetime.now()
+    report = GeneratorReport()
+
+    logger.info("Generating valid student IDs...")
+    report.valid_student_ids = _sample_unique_ids(
+        rng, 10_000, 99_999, num_students)
+    report.invalid_student_ids = _sample_unique_ids(
+        rng, 100_000, 999_999, num_invalid)
+    invalid_list = sorted(report.invalid_student_ids)
+
+    if sketch_store is not None:
+        # One batched preload call (vs the reference's per-ID BF.ADD loop).
+        sketch_store.bf_add_many(bloom_key, sorted(report.valid_student_ids))
+        logger.info("Added %d valid student IDs to Bloom Filter",
+                    len(report.valid_student_ids))
+
+    past_week = [now - timedelta(days=i) for i in range(7)]
+
+    def emit(event: AttendanceEvent) -> None:
+        if producer is not None:
+            producer.send(encode_event(event))
+        if keep_events:
+            report.events.append(event)
+        report.message_count += 1
+        if not event.is_valid:
+            report.invalid_attempts += 1
+        if report.message_count % 100 == 0:
+            logger.info("Generated %d attendance records (%d invalid "
+                        "attempts)", report.message_count,
+                        report.invalid_attempts)
+        if throttle_s:
+            import time
+            time.sleep(throttle_s)
+
+    def lecture_of(ts: datetime) -> str:
+        return f"LECTURE_{ts.strftime('%Y%m%d')}"
+
+    for student_id in sorted(report.valid_student_ids):
+        is_punctual = rng.random() > 0.2
+        attendance_days = rng.sample(past_week, rng.randint(3, 7))
+        for day in attendance_days:
+            entry_hour = (rng.randint(8, 9) if is_punctual
+                          else rng.randint(9, 11))
+            entry_time = day.replace(hour=entry_hour,
+                                     minute=rng.randint(0, 59),
+                                     second=0, microsecond=0)
+            exit_time = entry_time + timedelta(hours=rng.randint(3, 4),
+                                               minutes=rng.randint(0, 59))
+            emit(AttendanceEvent(student_id, entry_time.isoformat(),
+                                 lecture_of(entry_time), True, "entry"))
+            emit(AttendanceEvent(student_id, exit_time.isoformat(),
+                                 lecture_of(exit_time), True, "exit"))
+            if rng.random() < 0.15:
+                invalid_id = rng.choice(invalid_list)
+                emit(AttendanceEvent(invalid_id, entry_time.isoformat(),
+                                     lecture_of(entry_time), False, "entry"))
+
+    for _ in range(standalone_invalid):
+        invalid_id = rng.choice(invalid_list)
+        day = rng.choice(past_week)
+        ts = day.replace(hour=rng.randint(8, 17), minute=rng.randint(0, 59),
+                         second=0, microsecond=0)
+        emit(AttendanceEvent(invalid_id, ts.isoformat(), lecture_of(ts),
+                             False, "entry"))
+
+    logger.info("Total messages sent: %d (%d invalid attempts)",
+                report.message_count, report.invalid_attempts)
+    return report

@@ -1,0 +1,246 @@
+"""Micro-batched stream processor (the reference hot loop, rebuilt).
+
+The reference's per-event loop costs 3 service round-trips per event —
+``receive()`` -> ``BF.EXISTS`` -> Cassandra INSERT -> ``PFADD`` -> ack
+(reference attendance_processor.py:100-136). This processor keeps the same
+externally observable semantics (validity from the Bloom filter — the
+generator's ``is_valid`` flag is ignored and recomputed; every event is
+persisted with its computed validity; only valid events reach the HLL;
+ack strictly after all writes; nack-the-batch on failure -> redelivery)
+but amortizes everything over micro-batches:
+
+  receive() x B -> columnar decode -> ONE batched BF.EXISTS ->
+  ONE batched store insert -> ONE batched PFADD per (or, fused, total) ->
+  ack the B messages.
+
+With the TPU sketch backend the validate+count step is a single fused
+jitted dispatch (`fused_step`): Bloom gather/AND + HLL scatter-max execute
+back-to-back on device with no host round-trip in between. Replay of a
+nack'd batch is safe because every sink is idempotent (scatter-set-1,
+register max, upsert-by-PK) — SURVEY.md §5.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from attendance_tpu.config import Config
+from attendance_tpu.pipeline.events import AttendanceEvent, decode_event
+from attendance_tpu.sketch import make_sketch_store
+from attendance_tpu.sketch.base import ResponseError
+from attendance_tpu.storage import make_event_store
+from attendance_tpu.storage.memory_store import AttendanceRow
+from attendance_tpu.transport import make_client
+from attendance_tpu.transport.memory_broker import ReceiveTimeout
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ProcessorMetrics:
+    """Per-run counters (SURVEY.md §5 observability obligation)."""
+    batches: int = 0
+    events: int = 0
+    valid_events: int = 0
+    invalid_events: int = 0
+    nacked_batches: int = 0
+    dead_lettered: int = 0
+    device_seconds: float = 0.0
+    wall_seconds: float = 0.0
+    batch_sizes: List[int] = field(default_factory=list)
+
+    @property
+    def events_per_second(self) -> float:
+        return self.events / self.wall_seconds if self.wall_seconds else 0.0
+
+
+class AttendanceProcessor:
+    """Competing consumer turning event frames into sketch + store updates.
+
+    Construction wires the three backends from config (each injectable for
+    tests); ``process_attendance`` is the long-running entry point
+    mirroring the reference CLI, ``process_batch`` the testable core.
+    """
+
+    SUBSCRIPTION = "attendance_processor"
+
+    def __init__(self, config: Optional[Config] = None, *,
+                 client=None, sketch_store=None, event_store=None):
+        self.config = config or Config()
+        self.client = client or make_client(self.config)
+        self.consumer = self.client.subscribe(
+            self.config.pulsar_topic, self.SUBSCRIPTION)
+        self.sketch = sketch_store or make_sketch_store(self.config)
+        self.store = event_store or make_event_store(self.config)
+        self.metrics = ProcessorMetrics()
+
+    # -- setup --------------------------------------------------------------
+    def setup_bloom_filter(self) -> None:
+        """Reference bootstrap: probe, reserve on error, tolerate existing
+        (reference attendance_processor.py:74-92)."""
+        try:
+            self.sketch.execute_command(
+                "BF.EXISTS", self.config.bloom_filter_key, "test")
+            logger.info("Bloom Filter already exists")
+        except ResponseError:
+            try:
+                self.sketch.execute_command(
+                    "BF.RESERVE", self.config.bloom_filter_key,
+                    self.config.bloom_filter_error_rate,
+                    self.config.bloom_filter_capacity)
+                logger.info("Created new Bloom Filter")
+            except ResponseError as e:
+                if "exists" not in str(e):
+                    raise
+
+    # -- core batch step ----------------------------------------------------
+    def process_events(self, events: List[AttendanceEvent]) -> np.ndarray:
+        """Validate, persist, and count one micro-batch; returns the
+        computed validity vector (bool[B])."""
+        if not events:
+            return np.zeros(0, dtype=bool)
+        t0 = time.perf_counter()
+        student_ids = np.array([e.student_id for e in events],
+                               dtype=np.int64)
+
+        # 1. Batched BF.EXISTS — validity is recomputed, the embedded
+        #    ground-truth flag is deliberately ignored (reference
+        #    attendance_processor.py:109-113).
+        is_valid = np.asarray(self.sketch.bf_exists_many(
+            self.config.bloom_filter_key, student_ids))
+        self.metrics.device_seconds += time.perf_counter() - t0
+
+        # 2. Persist every event with computed validity (reference
+        #    attendance_processor.py:116-124 stores valid and invalid alike).
+        rows = [AttendanceRow(student_id=int(e.student_id),
+                              timestamp=e.timestamp,
+                              lecture_id=e.lecture_id,
+                              is_valid=bool(v),
+                              event_type=e.event_type)
+                for e, v in zip(events, is_valid)]
+        self.store.insert_batch(rows)
+
+        # 3. Valid events only -> HLL, one PFADD per distinct lecture key
+        #    (reference attendance_processor.py:127-129).
+        t1 = time.perf_counter()
+        by_lecture: Dict[str, List[int]] = {}
+        for e, v in zip(events, is_valid):
+            if v:
+                by_lecture.setdefault(e.lecture_id, []).append(e.student_id)
+        for lecture_id, members in by_lecture.items():
+            self.sketch.pfadd_many(
+                f"{self.config.hll_key_prefix}{lecture_id}",
+                np.array(members, dtype=np.int64))
+        self.metrics.device_seconds += time.perf_counter() - t1
+
+        nv = int(is_valid.sum())
+        self.metrics.batches += 1
+        self.metrics.events += len(events)
+        self.metrics.valid_events += nv
+        self.metrics.invalid_events += len(events) - nv
+        self.metrics.batch_sizes.append(len(events))
+        return is_valid
+
+    # -- streaming loop -----------------------------------------------------
+    def _collect_batch(self) -> List:
+        """Fill a batch from the consumer: up to batch_size messages, or
+        whatever arrived when batch_timeout_s expires (partial batch)."""
+        msgs = []
+        deadline = time.monotonic() + self.config.batch_timeout_s
+        while len(msgs) < self.config.batch_size:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 and msgs:
+                break
+            timeout_ms = max(1, int(max(remaining, 0) * 1000))
+            try:
+                msgs.append(self.consumer.receive(timeout_millis=timeout_ms))
+            except ReceiveTimeout:
+                break
+        return msgs
+
+    def process_attendance(self, max_events: Optional[int] = None,
+                           idle_timeout_s: Optional[float] = None) -> None:
+        """Long-running consume loop (reference entry point,
+        attendance_processor.py:94-141).
+
+        max_events / idle_timeout_s bound the run for tests and batch jobs;
+        both None = run until interrupted, like the reference.
+        """
+        logger.info("Starting attendance processing...")
+        self.setup_bloom_filter()
+        t_start = time.perf_counter()
+        idle_since = time.monotonic()
+        try:
+            while True:
+                msgs = self._collect_batch()
+                if not msgs:
+                    if (idle_timeout_s is not None and
+                            time.monotonic() - idle_since > idle_timeout_s):
+                        break
+                    continue
+                idle_since = time.monotonic()
+                # Per-frame decode so one poison frame doesn't poison the
+                # batch: undecodable frames are retried (nack) up to
+                # max_redeliveries, then dead-lettered (acked + counted) —
+                # the bounded version of the reference's nack-forever
+                # (attendance_processor.py:134-136; no DLQ, SURVEY.md §5).
+                good_msgs, events = [], []
+                for m in msgs:
+                    try:
+                        events.append(decode_event(m.data()))
+                        good_msgs.append(m)
+                    except Exception:
+                        if (m.redelivery_count
+                                >= self.config.max_redeliveries):
+                            logger.error("Dead-lettering undecodable frame "
+                                         "after %d redeliveries",
+                                         m.redelivery_count)
+                            self.metrics.dead_lettered += 1
+                            self.consumer.acknowledge(m)
+                        else:
+                            self.consumer.negative_acknowledge(m)
+                try:
+                    self.process_events(events)
+                except Exception:
+                    # Whole-batch nack -> broker redelivery; idempotent
+                    # sinks make the replay safe (SURVEY.md §5).
+                    logger.exception("Error processing batch; nacking")
+                    self.metrics.nacked_batches += 1
+                    for m in good_msgs:
+                        if (m.redelivery_count
+                                >= self.config.max_redeliveries):
+                            self.metrics.dead_lettered += 1
+                            self.consumer.acknowledge(m)
+                        else:
+                            self.consumer.negative_acknowledge(m)
+                    continue
+                # Ack strictly after sketch + store writes committed
+                # (reference attendance_processor.py:132).
+                for m in good_msgs:
+                    self.consumer.acknowledge(m)
+                if max_events is not None and (
+                        self.metrics.events >= max_events):
+                    break
+        except KeyboardInterrupt:
+            logger.info("Stopping attendance processing...")
+        finally:
+            self.metrics.wall_seconds = time.perf_counter() - t_start
+
+    # -- query path ---------------------------------------------------------
+    def get_attendance_stats(self, lecture_id: str) -> Dict:
+        """PFCOUNT + partition scan (reference
+        attendance_processor.py:149-165)."""
+        unique = self.sketch.pfcount(
+            f"{self.config.hll_key_prefix}{lecture_id}")
+        records = self.store.scan_lecture(lecture_id)
+        return {"unique_attendees": unique, "attendance_records": records}
+
+    def cleanup(self) -> None:
+        self.client.close()
+        self.sketch.close()
+        self.store.close()

@@ -159,8 +159,13 @@ class SketchStore(abc.ABC):
 
     @abc.abstractmethod
     def _hll_add(self, key: str, keys_u32: np.ndarray,
-                 mask: Optional[np.ndarray] = None) -> int:
-        """Batched PFADD; returns 1 if any register changed."""
+                 mask: Optional[np.ndarray] = None,
+                 want_changed: bool = True) -> int:
+        """Batched PFADD; returns 1 if any register changed.
+
+        want_changed=False lets device backends skip the host round-trip
+        that computing the flag costs; the return value is then 0 and
+        meaningless (the micro-batch hot loop never reads it)."""
 
     @abc.abstractmethod
     def _hll_count(self, keys: Sequence[str]) -> int:
@@ -200,8 +205,10 @@ class SketchStore(abc.ABC):
         return self._hll_add(key, members_to_u32(members))
 
     def pfadd_many(self, key: str, members,
-                   mask: Optional[np.ndarray] = None) -> int:
-        return self._hll_add(key, members_to_u32(members), mask)
+                   mask: Optional[np.ndarray] = None,
+                   want_changed: bool = False) -> int:
+        return self._hll_add(key, members_to_u32(members), mask,
+                             want_changed)
 
     def pfcount(self, *keys: str) -> int:
         return self._hll_count(keys)

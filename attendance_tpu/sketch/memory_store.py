@@ -40,7 +40,8 @@ class MemorySketchStore(SketchStore):
 
     # -- HLL primitives -----------------------------------------------------
     def _hll_add(self, key: str, keys_u32: np.ndarray,
-                 mask: Optional[np.ndarray] = None) -> int:
+                 mask: Optional[np.ndarray] = None,
+                 want_changed: bool = True) -> int:
         regs = self._hll_regs.get(key)
         if regs is None:
             regs = self._hll_regs[key] = np.zeros(
@@ -62,6 +63,16 @@ class MemorySketchStore(SketchStore):
         q = 64 - self.precision
         hist = np.bincount(merged, minlength=q + 2)
         return int(round(estimate_from_histogram(hist, self.precision)))
+
+    # -- snapshot/restore hooks (attendance_tpu.utils.snapshot) -------------
+    def _restore_filter(self, params: BloomParams, bits: np.ndarray):
+        return np.array(bits, dtype=np.uint8)
+
+    def _restore_hll_per_key(self, regs: Dict[str, np.ndarray],
+                             precision: int) -> None:
+        self.precision = precision
+        self._hll_regs = {k: np.array(v, dtype=np.uint8)
+                          for k, v in regs.items()}
 
     def flush(self) -> None:
         super().flush()

@@ -46,7 +46,8 @@ class RedisSketchStore(SketchStore):
     def _filter_contains(self, handle, params, keys):  # pragma: no cover
         raise NotImplementedError
 
-    def _hll_add(self, key, keys_u32, mask=None):  # pragma: no cover
+    def _hll_add(self, key, keys_u32, mask=None,
+                 want_changed=True):  # pragma: no cover
         raise NotImplementedError
 
     def _hll_count(self, keys):  # pragma: no cover
@@ -83,7 +84,8 @@ class RedisSketchStore(SketchStore):
         return int(self.client.pfadd(key, *members))
 
     def pfadd_many(self, key: str, members,
-                   mask: Optional[np.ndarray] = None) -> int:
+                   mask: Optional[np.ndarray] = None,
+                   want_changed: bool = False) -> int:
         members = np.asarray(members)
         if mask is not None:
             members = members[mask]

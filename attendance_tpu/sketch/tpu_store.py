@@ -75,15 +75,20 @@ class TpuSketchStore(SketchStore):
 
     # -- HLL primitives -----------------------------------------------------
     def _hll_add(self, key: str, keys_u32: np.ndarray,
-                 mask: Optional[np.ndarray] = None) -> int:
+                 mask: Optional[np.ndarray] = None,
+                 want_changed: bool = True) -> int:
         idx = self._hll.bank_index(key)
-        # "Did any register change?" computed host-side from the pre-update
-        # row (PFADD's return value; scalar path only, off the hot loop).
-        bucket, rank = hll_bucket_rank_np(keys_u32, self._hll.precision)
-        if mask is not None:
-            rank = np.where(mask, rank, 0)
-        row = np.asarray(self._hll.regs[idx])
-        changed = bool((rank > row[bucket]).any())
+        changed = False
+        if want_changed:
+            # "Did any register change?" computed host-side from the
+            # pre-update row. Costs a blocking device->host row copy, so
+            # the micro-batch hot loop requests want_changed=False; only
+            # the scalar redis-compatible pfadd() pays for it.
+            bucket, rank = hll_bucket_rank_np(keys_u32, self._hll.precision)
+            if mask is not None:
+                rank = np.where(mask, rank, 0)
+            row = np.asarray(self._hll.regs[idx])
+            changed = bool((rank > row[bucket]).any())
         n = len(keys_u32)
         padded = pad_to_pow2(n)
         kbuf = np.zeros(padded, dtype=np.uint32)
@@ -109,6 +114,17 @@ class TpuSketchStore(SketchStore):
     def bloom_chain(self, key: str):
         """The ScalableBloom chain for a key (None if absent)."""
         return self._blooms.get(key)
+
+    # -- snapshot/restore hooks (attendance_tpu.utils.snapshot) -------------
+    def _restore_filter(self, params: BloomParams, bits: np.ndarray):
+        return jnp.asarray(np.asarray(bits, dtype=np.uint8))
+
+    def _restore_hll_banked(self, regs: np.ndarray, bank_of: Dict[str, int],
+                            precision: int) -> None:
+        self._hll = HyperLogLog(initial_banks=regs.shape[0],
+                                precision=precision)
+        self._hll.regs = jnp.asarray(np.asarray(regs, dtype=np.uint8))
+        self._hll._bank_of = {str(k): int(v) for k, v in bank_of.items()}
 
     def flush(self) -> None:
         super().flush()

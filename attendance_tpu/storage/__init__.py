@@ -1,0 +1,29 @@
+"""Persistent event-store layer with the reference's Cassandra semantics.
+
+The reference persists every event (valid or not) to a single
+``attendance`` table — partition key ``(lecture_id)``, clustering
+``(timestamp, student_id)``, columns ``(student_id, timestamp, lecture_id,
+is_valid, event_type)`` — via per-event INSERTs (reference
+attendance_processor.py:64-72,116-124), and reads it back with
+``SELECT DISTINCT lecture_id`` + per-lecture filtered scans (reference
+attendance_analysis.py:22-39, attendance_processor.py:155-160). Backends
+selected by ``--storage-backend``:
+
+  * "memory"    — hermetic in-process table with identical upsert-by-
+                  primary-key semantics (idempotent under at-least-once
+                  replay) plus batched inserts for the micro-batch path.
+  * "cassandra" — the real service via cassandra-driver (import-gated).
+"""
+
+from attendance_tpu.storage.memory_store import (  # noqa: F401
+    AttendanceRow, MemoryEventStore)
+
+
+def make_event_store(config):
+    """Build the event store selected by config.storage_backend."""
+    if config.storage_backend == "memory":
+        return MemoryEventStore()
+    if config.storage_backend == "cassandra":
+        from attendance_tpu.storage.cassandra_store import CassandraEventStore
+        return CassandraEventStore(config)
+    raise ValueError(f"unknown storage backend {config.storage_backend!r}")

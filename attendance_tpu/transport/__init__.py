@@ -1,0 +1,30 @@
+"""Event-transport layer with Pulsar call-shape compatibility.
+
+The reference's transport is an external Pulsar broker driven through
+pulsar-client: ``Client(host).create_producer(topic).send(bytes)`` on the
+producer side (reference data_generator.py:40-41,122) and
+``subscribe(topic, sub, consumer_type=Shared)`` / ``receive()`` /
+``acknowledge()`` / ``negative_acknowledge()`` on the consumer side
+(reference attendance_processor.py:29-34,101,132,136). This package keeps
+those call shapes API-stable across two backends selected by
+``--transport-backend``:
+
+  * "memory" — hermetic in-process broker with the same delivery
+               semantics: shared-subscription competing consumers,
+               per-message ack, nack->redelivery, at-least-once.
+  * "pulsar" — the real broker via pulsar-client (import-gated).
+"""
+
+from attendance_tpu.transport.memory_broker import (  # noqa: F401
+    MemoryBroker, MemoryClient, ReceiveTimeout)
+
+
+def make_client(config):
+    """Build the transport client selected by config.transport_backend."""
+    if config.transport_backend == "memory":
+        return MemoryClient(MemoryBroker.shared())
+    if config.transport_backend == "pulsar":
+        from attendance_tpu.transport.pulsar_client import PulsarClient
+        return PulsarClient(config.pulsar_host)
+    raise ValueError(
+        f"unknown transport backend {config.transport_backend!r}")

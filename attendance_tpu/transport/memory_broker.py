@@ -1,0 +1,227 @@
+"""Hermetic in-process broker with Pulsar delivery semantics.
+
+Implements exactly the slice of Pulsar behavior the reference relies on
+(SURVEY.md §5 "failure detection"): durable topic buffering, *shared*
+subscriptions where competing consumers each receive disjoint messages
+(reference attendance_processor.py:30-34), per-message acknowledge, and
+negative_acknowledge -> redelivery to any consumer of the subscription
+(reference attendance_processor.py:132,134-136). Unacked messages from a
+closed consumer return to the subscription queue (crash takeover).
+
+Thread-safe: producers and consumers may live on different threads (the
+pipelined processor overlaps host ingest with device dispatch).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+# Messages retained for late-joining subscriptions (the generator often
+# finishes before the processor subscribes). Bounded so a long-running
+# broker doesn't hold every payload ever published: a late subscriber sees
+# the most recent RETAINED_LIMIT messages, like a topic with bounded
+# retention.
+RETAINED_LIMIT = 1 << 16
+
+
+class ReceiveTimeout(Exception):
+    """receive(timeout_millis) expired with no message (maps to
+    pulsar.Timeout in the real client)."""
+
+
+class Message:
+    """A delivered message: payload bytes + broker bookkeeping ids."""
+
+    __slots__ = ("_data", "message_id", "redelivery_count")
+
+    def __init__(self, data: bytes, message_id: int, redelivery_count: int):
+        self._data = data
+        self.message_id = message_id
+        self.redelivery_count = redelivery_count
+
+    def data(self) -> bytes:
+        return self._data
+
+
+class _Subscription:
+    """One named subscription on a topic: a shared pending queue plus an
+    in-flight (delivered, unacked) map — Pulsar Shared subscription."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.pending: Deque[Tuple[int, bytes, int]] = deque()
+        self.inflight: Dict[int, Tuple[bytes, int]] = {}
+        self.cond = threading.Condition()
+
+    def enqueue(self, message_id: int, data: bytes, redeliveries: int = 0):
+        with self.cond:
+            self.pending.append((message_id, data, redeliveries))
+            self.cond.notify()
+
+    def receive(self, timeout_s: Optional[float]) -> Message:
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        with self.cond:
+            # Loop: a competing consumer may steal the message between
+            # notify and wake-up, and waits can wake spuriously.
+            while not self.pending:
+                if deadline is None:
+                    self.cond.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ReceiveTimeout(
+                        f"no message within {timeout_s}s on {self.name!r}")
+                self.cond.wait(remaining)
+            mid, data, redeliveries = self.pending.popleft()
+            self.inflight[mid] = (data, redeliveries)
+            return Message(data, mid, redeliveries)
+
+    def acknowledge(self, message_id: int) -> None:
+        with self.cond:
+            self.inflight.pop(message_id, None)
+
+    def negative_acknowledge(self, message_id: int) -> None:
+        with self.cond:
+            entry = self.inflight.pop(message_id, None)
+            if entry is not None:
+                data, redeliveries = entry
+                self.pending.append((message_id, data, redeliveries + 1))
+                self.cond.notify()
+
+    def requeue_inflight(self) -> None:
+        """Crash takeover: return every unacked message to the queue."""
+        with self.cond:
+            for mid, (data, redeliveries) in self.inflight.items():
+                self.pending.append((mid, data, redeliveries + 1))
+            self.inflight.clear()
+            self.cond.notify_all()
+
+    def backlog(self) -> int:
+        with self.cond:
+            return len(self.pending) + len(self.inflight)
+
+
+class _Topic:
+    def __init__(self, name: str):
+        self.name = name
+        self.lock = threading.Lock()
+        self.subscriptions: Dict[str, _Subscription] = {}
+        self.retained: Deque[Tuple[int, bytes]] = deque(maxlen=RETAINED_LIMIT)
+        self._ids = itertools.count()
+
+    def subscription(self, name: str) -> _Subscription:
+        with self.lock:
+            sub = self.subscriptions.get(name)
+            if sub is None:
+                sub = self.subscriptions[name] = _Subscription(name)
+                # A new subscription starts at the earliest retained
+                # message (the generator may run before the processor).
+                for mid, data in self.retained:
+                    sub.enqueue(mid, data)
+            return sub
+
+    def publish(self, data: bytes) -> int:
+        with self.lock:
+            mid = next(self._ids)
+            self.retained.append((mid, data))
+            subs = list(self.subscriptions.values())
+        for sub in subs:
+            sub.enqueue(mid, data)
+        return mid
+
+
+class MemoryBroker:
+    """Process-wide topic registry (one per process, like one broker)."""
+
+    _shared: Optional["MemoryBroker"] = None
+    _shared_lock = threading.Lock()
+
+    def __init__(self):
+        self._topics: Dict[str, _Topic] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def shared(cls) -> "MemoryBroker":
+        with cls._shared_lock:
+            if cls._shared is None:
+                cls._shared = cls()
+            return cls._shared
+
+    @classmethod
+    def reset_shared(cls) -> None:
+        with cls._shared_lock:
+            cls._shared = None
+
+    def topic(self, name: str) -> _Topic:
+        with self._lock:
+            t = self._topics.get(name)
+            if t is None:
+                t = self._topics[name] = _Topic(name)
+            return t
+
+
+class MemoryProducer:
+    def __init__(self, topic: _Topic):
+        self._topic = topic
+        self._closed = False
+
+    def send(self, data: bytes) -> int:
+        if self._closed:
+            raise RuntimeError("producer closed")
+        return self._topic.publish(bytes(data))
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        self._closed = True
+
+
+class MemoryConsumer:
+    def __init__(self, sub: _Subscription):
+        self._sub = sub
+        self._closed = False
+
+    def receive(self, timeout_millis: Optional[int] = None) -> Message:
+        if self._closed:
+            raise RuntimeError("consumer closed")
+        timeout_s = None if timeout_millis is None else timeout_millis / 1e3
+        return self._sub.receive(timeout_s)
+
+    def acknowledge(self, msg: Message) -> None:
+        self._sub.acknowledge(msg.message_id)
+
+    def negative_acknowledge(self, msg: Message) -> None:
+        self._sub.negative_acknowledge(msg.message_id)
+
+    def backlog(self) -> int:
+        return self._sub.backlog()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._sub.requeue_inflight()
+
+
+class MemoryClient:
+    """pulsar.Client call-shape over the in-process broker."""
+
+    def __init__(self, broker: MemoryBroker):
+        self._broker = broker
+
+    def create_producer(self, topic: str) -> MemoryProducer:
+        return MemoryProducer(self._broker.topic(topic))
+
+    def subscribe(self, topic: str, subscription_name: str,
+                  consumer_type=None) -> MemoryConsumer:
+        del consumer_type  # shared semantics are the only mode implemented
+        return MemoryConsumer(
+            self._broker.topic(topic).subscription(subscription_name))
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,39 @@
+"""Real Pulsar transport (import-gated).
+
+Thin adapter keeping the same call shapes as the memory broker; only
+imported when ``--transport-backend=pulsar`` is selected, so the framework
+runs hermetically where pulsar-client is not installed. Mirrors the
+reference's usage: Shared subscription, ack/nack per message (reference
+attendance_processor.py:29-34,101,132,136).
+"""
+
+from __future__ import annotations
+
+try:
+    import pulsar as _pulsar
+    HAVE_PULSAR = True
+except ImportError:  # pragma: no cover - environment without pulsar-client
+    _pulsar = None
+    HAVE_PULSAR = False
+
+
+class PulsarClient:
+    def __init__(self, service_url: str):
+        if not HAVE_PULSAR:
+            raise RuntimeError(
+                "transport_backend='pulsar' requires the pulsar-client "
+                "package")
+        self._client = _pulsar.Client(service_url)
+
+    def create_producer(self, topic: str):
+        return self._client.create_producer(topic)
+
+    def subscribe(self, topic: str, subscription_name: str,
+                  consumer_type=None):
+        if consumer_type is None:
+            consumer_type = _pulsar.ConsumerType.Shared
+        return self._client.subscribe(
+            topic, subscription_name, consumer_type=consumer_type)
+
+    def close(self) -> None:
+        self._client.close()

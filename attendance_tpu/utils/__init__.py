@@ -1,0 +1,11 @@
+"""Auxiliary subsystems: snapshot/restore, metrics, profiling hooks.
+
+The reference keeps all durable state in external services, so a restart
+resumes from the broker cursor for free (SURVEY.md §5 checkpoint/resume).
+Here HBM sketch state is process-local, so snapshot/restore is the
+framework's obligation: device->host->disk of the Bloom chains and the
+HLL register banks plus their name maps, and back.
+"""
+
+from attendance_tpu.utils.snapshot import (  # noqa: F401
+    restore_sketch_store, snapshot_sketch_store)

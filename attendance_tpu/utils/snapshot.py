@@ -1,0 +1,106 @@
+"""Sketch-state snapshot/restore (device -> host -> disk and back).
+
+Format: one ``.npz`` per snapshot holding every Bloom sub-filter's bit
+array, every HLL register bank, and a JSON manifest (bloom chain params,
+HLL name->bank map, counters). Writes are atomic (tmp file + rename) so a
+crash mid-snapshot never corrupts the last good one. Restoring into a
+fresh store then resuming from the broker cursor reproduces the
+reference's restart story (SURVEY.md §5): replayed events land in
+idempotent sinks, so at-least-once resume is lossless.
+
+Works for both host-side (memory) and device-side (tpu) stores: state is
+pulled with np.asarray (device->host copy for jax arrays, no-op for
+numpy) and pushed back with the store's native array type.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict
+
+import numpy as np
+
+from attendance_tpu.models.bloom import BloomParams
+
+
+def snapshot_sketch_store(store, path) -> Dict:
+    """Write the store's full sketch state to ``path`` (.npz)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    manifest: Dict = {"blooms": {}, "hll": {}}
+
+    for key, chain in store._blooms.items():
+        filters = []
+        for i, (handle, params) in enumerate(zip(chain.filters,
+                                                 chain.params)):
+            name = f"bloom/{key}/{i}"
+            arrays[name] = np.asarray(handle)
+            filters.append({"array": name, "params": list(params[:2]) + [
+                params.layout, params.capacity, params.error_rate]})
+        manifest["blooms"][key] = {
+            "base_capacity": chain.base_capacity,
+            "base_error": chain.base_error,
+            "layout": chain.layout,
+            "counts": chain.counts,
+            "filters": filters,
+        }
+
+    hll = getattr(store, "_hll", None)
+    if hll is not None:  # TpuSketchStore: one banked array + name map
+        arrays["hll/regs"] = np.asarray(hll.regs)
+        manifest["hll"] = {"kind": "banked", "precision": hll.precision,
+                           "bank_of": hll._bank_of}
+    else:  # MemorySketchStore: dict of per-key register arrays
+        regs = getattr(store, "_hll_regs", {})
+        for i, (key, arr) in enumerate(regs.items()):
+            arrays[f"hll/{i}"] = arr
+        manifest["hll"] = {
+            "kind": "per_key",
+            "precision": getattr(store, "precision", 14),
+            "keys": list(regs.keys()),
+        }
+
+    arrays["__manifest__"] = np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    tmp.replace(path)
+    return manifest
+
+
+def restore_sketch_store(store, path) -> None:
+    """Load a snapshot into a freshly constructed store (same backend)."""
+    from attendance_tpu.sketch.base import ScalableBloom
+
+    with np.load(Path(path)) as data:
+        manifest = json.loads(bytes(data["__manifest__"]).decode())
+
+        store._blooms.clear()
+        for key, info in manifest["blooms"].items():
+            chain = ScalableBloom.__new__(ScalableBloom)
+            chain.store = store
+            chain.base_capacity = info["base_capacity"]
+            chain.base_error = info["base_error"]
+            chain.layout = info["layout"]
+            chain.counts = list(info["counts"])
+            chain.filters, chain.params = [], []
+            for finfo in info["filters"]:
+                m_bits, k, layout, capacity, error_rate = finfo["params"]
+                params = BloomParams(int(m_bits), int(k), layout,
+                                     int(capacity), float(error_rate))
+                bits = data[finfo["array"]]
+                chain.params.append(params)
+                chain.filters.append(store._restore_filter(params, bits))
+            store._blooms[key] = chain
+
+        hinfo = manifest["hll"]
+        if hinfo.get("kind") == "banked":
+            store._restore_hll_banked(data["hll/regs"], hinfo["bank_of"],
+                                      hinfo["precision"])
+        elif hinfo.get("kind") == "per_key":
+            regs = {key: data[f"hll/{i}"]
+                    for i, key in enumerate(hinfo["keys"])}
+            store._restore_hll_per_key(regs, hinfo["precision"])

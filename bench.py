@@ -1,0 +1,111 @@
+"""Benchmark rig: sustained events/sec through the fused sketch step.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+What is measured: the device hot path the north star targets — the fused
+Bloom-validate + HLL-count micro-batch program (the reference's per-event
+BF.EXISTS -> PFADD loop body, reference attendance_processor.py:109-129,
+rebuilt as one XLA dispatch per batch). Keys are pre-staged uint32 batches;
+steps are enqueued back-to-back (donated state, async dispatch) and timed
+end-to-end over `--seconds` of wall clock after a warmup.
+
+vs_baseline is measured-throughput / north-star-target (50M ev/s on a
+v5e-8, BASELINE.json); >1.0 beats the target. On the single chip the
+driver runs this against, the per-chip share of the target is 50M/8.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NORTH_STAR_EVENTS_PER_SEC = 50e6  # v5e-8, BASELINE.json
+TARGET_CHIPS = 8
+
+
+def bench_fused_step(batch_size: int, seconds: float, capacity: int,
+                     num_banks: int, layout: str) -> dict:
+    from attendance_tpu.models.fused import init_state, make_jitted_step
+
+    state, params = init_state(capacity=capacity, error_rate=0.01,
+                               layout=layout, num_banks=num_banks)
+    step = make_jitted_step(params)
+
+    rng = np.random.default_rng(0)
+    roster = rng.choice(1 << 31, size=capacity, replace=False
+                        ).astype(np.uint32)
+    # Preload the roster so ~half the stream validates true.
+    from attendance_tpu.models.bloom import bloom_add
+    state = state._replace(bloom_bits=jax.jit(
+        lambda b, k: bloom_add(b, k, params), donate_argnums=(0,))(
+            state.bloom_bits, jnp.asarray(roster)))
+
+    n_bufs = 8  # rotate pre-staged device-resident input batches
+    keys_bufs, bank_bufs = [], []
+    for _ in range(n_bufs):
+        mix = np.where(rng.random(batch_size) < 0.5,
+                       rng.choice(roster, size=batch_size),
+                       rng.integers(1 << 31, 1 << 32, size=batch_size,
+                                    dtype=np.uint32)).astype(np.uint32)
+        keys_bufs.append(jax.device_put(mix))
+        bank_bufs.append(jax.device_put(
+            rng.integers(0, num_banks, size=batch_size, dtype=np.int32)))
+    mask = jax.device_put(np.ones(batch_size, dtype=bool))
+
+    # warmup / compile
+    state, valid = step(state, keys_bufs[0], bank_bufs[0], mask)
+    valid.block_until_ready()
+
+    steps = 0
+    t0 = time.perf_counter()
+    while True:
+        state, valid = step(state, keys_bufs[steps % n_bufs],
+                            bank_bufs[steps % n_bufs], mask)
+        steps += 1
+        if steps % 50 == 0:
+            valid.block_until_ready()
+            if time.perf_counter() - t0 >= seconds:
+                break
+    valid.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    events_per_sec = steps * batch_size / elapsed
+    return {
+        "events_per_sec": events_per_sec,
+        "steps": steps,
+        "batch_size": batch_size,
+        "elapsed_s": elapsed,
+        "device": str(jax.devices()[0]),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=1 << 20)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--capacity", type=int, default=1_000_000)
+    ap.add_argument("--num-banks", type=int, default=64)
+    ap.add_argument("--layout", default="blocked",
+                    choices=["blocked", "flat"])
+    args = ap.parse_args()
+
+    r = bench_fused_step(args.batch_size, args.seconds, args.capacity,
+                         args.num_banks, args.layout)
+    n_chips = max(1, len(jax.devices()))
+    # Compare against this run's fair share of the 8-chip north star.
+    target_here = NORTH_STAR_EVENTS_PER_SEC * min(n_chips, TARGET_CHIPS) \
+        / TARGET_CHIPS
+    print(json.dumps({
+        "metric": "fused_sketch_step_throughput",
+        "value": round(r["events_per_sec"], 1),
+        "unit": "events/sec",
+        "vs_baseline": round(r["events_per_sec"] / target_here, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,17 +2,25 @@
 
 Tests run hermetically on the CPU backend with 8 virtual devices so the
 multi-chip sharding paths (hash-prefix sharded sketches, OR/max
-collectives) are exercised without a TPU pod — SURVEY.md §4. This must run
-before the first `import jax` in any test module, hence env mutation at
-conftest import time (the axon sitecustomize pins JAX_PLATFORMS=axon, so
-we override it here).
+collectives) are exercised without a TPU pod — SURVEY.md §4.
+
+The axon sitecustomize imports jax at interpreter start, so jax's config
+has already captured JAX_PLATFORMS=axon before this file runs — setting
+env vars here is too late. Overrides therefore go through the config API
+(backends are still uninitialized at conftest-import time, so they take
+effect). The persistent compilation cache matters: XLA:CPU compiles of the
+larger scatter/gather programs run tens of seconds; caching them on disk
+makes every pytest process after the first start warm.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["JAX_PLATFORM_NAME"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

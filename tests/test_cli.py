@@ -1,0 +1,17 @@
+"""CLI smoke tests: the hermetic pipeline subcommand end-to-end."""
+
+from attendance_tpu.cli import main
+
+
+def test_pipeline_subcommand_memory_backend(capsys):
+    main(["pipeline", "--sketch-backend", "memory", "--num-students", "40",
+          "--num-invalid", "5", "--seed", "1", "--batch-size", "128",
+          "--batch-timeout-s", "0.01"])
+    out = capsys.readouterr().out
+    assert "Habitual Latecomers" in out
+    assert "Invalid Attendance Attempts" in out
+
+
+def test_analyze_subcommand_empty(capsys):
+    main(["analyze", "--sketch-backend", "memory"])
+    assert "No insights available" in capsys.readouterr().out

@@ -1,0 +1,48 @@
+"""Event-store semantics: PK upsert idempotence, ordered scans, save/load."""
+
+from attendance_tpu.storage.memory_store import AttendanceRow, MemoryEventStore
+
+
+def row(student=1, ts="2026-07-27T08:30:00", lecture="LECTURE_20260727",
+        valid=True, etype="entry"):
+    return AttendanceRow(student_id=student, timestamp=ts,
+                         lecture_id=lecture, is_valid=valid,
+                         event_type=etype)
+
+
+def test_upsert_by_primary_key_is_idempotent():
+    """Replayed batches overwrite in place (reference Cassandra PK
+    semantics, attendance_processor.py:64-72; SURVEY.md §5)."""
+    store = MemoryEventStore()
+    store.insert_batch([row(), row(), row(student=2)])
+    assert store.count() == 2
+    store.insert_batch([row()])  # replay
+    assert store.count() == 2
+
+
+def test_scan_orders_by_clustering_key():
+    store = MemoryEventStore()
+    store.insert(row(student=2, ts="2026-07-27T10:00:00"))
+    store.insert(row(student=1, ts="2026-07-27T08:00:00"))
+    store.insert(row(student=3, ts="2026-07-27T08:00:00", lecture="OTHER"))
+    scanned = store.scan_lecture("LECTURE_20260727")
+    assert [(r.timestamp, r.student_id) for r in scanned] == [
+        ("2026-07-27T08:00:00", 1), ("2026-07-27T10:00:00", 2)]
+
+
+def test_distinct_lectures_and_scan_all():
+    store = MemoryEventStore()
+    store.insert(row(lecture="B"))
+    store.insert(row(lecture="A", student=5))
+    assert store.distinct_lecture_ids() == ["A", "B"]
+    assert len(store.scan_all()) == 2
+
+
+def test_save_load_roundtrip(tmp_path):
+    store = MemoryEventStore()
+    store.insert_batch([row(), row(student=2, valid=False, etype="exit")])
+    path = tmp_path / "events.jsonl"
+    store.save(path)
+    restored = MemoryEventStore()
+    assert restored.load(path) == 2
+    assert restored.scan_all() == store.scan_all()

@@ -1,0 +1,99 @@
+"""Memory broker semantics: shared subscription, ack/nack, takeover."""
+
+import threading
+
+import pytest
+
+from attendance_tpu.transport.memory_broker import (
+    MemoryBroker, MemoryClient, ReceiveTimeout)
+
+
+def make_client():
+    return MemoryClient(MemoryBroker())
+
+
+def test_publish_receive_ack():
+    client = make_client()
+    producer = client.create_producer("t")
+    consumer = client.subscribe("t", "sub")
+    producer.send(b"a")
+    producer.send(b"b")
+    m1 = consumer.receive(timeout_millis=100)
+    m2 = consumer.receive(timeout_millis=100)
+    assert (m1.data(), m2.data()) == (b"a", b"b")
+    consumer.acknowledge(m1)
+    consumer.acknowledge(m2)
+    assert consumer.backlog() == 0
+    with pytest.raises(ReceiveTimeout):
+        consumer.receive(timeout_millis=10)
+
+
+def test_nack_redelivers():
+    client = make_client()
+    producer = client.create_producer("t")
+    consumer = client.subscribe("t", "sub")
+    producer.send(b"x")
+    m = consumer.receive(timeout_millis=100)
+    consumer.negative_acknowledge(m)
+    m2 = consumer.receive(timeout_millis=100)
+    assert m2.data() == b"x"
+    assert m2.redelivery_count == 1
+    consumer.acknowledge(m2)
+    assert consumer.backlog() == 0
+
+
+def test_shared_subscription_competing_consumers():
+    """Two consumers on one subscription split the stream disjointly
+    (Pulsar Shared semantics, reference attendance_processor.py:30-34)."""
+    client = make_client()
+    producer = client.create_producer("t")
+    c1 = client.subscribe("t", "sub")
+    c2 = client.subscribe("t", "sub")
+    for i in range(10):
+        producer.send(bytes([i]))
+    seen = []
+    for c in (c1, c2) * 5:
+        m = c.receive(timeout_millis=100)
+        seen.append(m.data()[0])
+        c.acknowledge(m)
+    assert sorted(seen) == list(range(10))
+
+
+def test_new_subscription_sees_retained_messages():
+    """The generator may finish before the processor subscribes."""
+    client = make_client()
+    producer = client.create_producer("t")
+    producer.send(b"early")
+    consumer = client.subscribe("t", "late-sub")
+    assert consumer.receive(timeout_millis=100).data() == b"early"
+
+
+def test_consumer_close_requeues_inflight():
+    """Crash takeover: unacked messages return to the shared queue."""
+    client = make_client()
+    producer = client.create_producer("t")
+    c1 = client.subscribe("t", "sub")
+    producer.send(b"m")
+    c1.receive(timeout_millis=100)  # delivered, never acked
+    c1.close()
+    c2 = client.subscribe("t", "sub")
+    m = c2.receive(timeout_millis=100)
+    assert m.data() == b"m"
+    assert m.redelivery_count == 1
+
+
+def test_cross_thread_delivery():
+    client = make_client()
+    consumer = client.subscribe("t", "sub")
+    got = []
+
+    def consume():
+        m = consumer.receive(timeout_millis=2000)
+        got.append(m.data())
+        consumer.acknowledge(m)
+
+    th = threading.Thread(target=consume)
+    th.start()
+    client.create_producer("t").send(b"threaded")
+    th.join(timeout=5)
+    assert got == [b"threaded"]
