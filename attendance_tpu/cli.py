@@ -107,8 +107,8 @@ def cmd_pipeline(args) -> None:
     m = processor.metrics
     logger.info("Processed %d/%d events (%.0f ev/s)", m.events,
                 report.message_count, m.events_per_second)
-    AttendanceAnalyzer(processor.store).print_insights(
-        AttendanceAnalyzer(processor.store).generate_insights())
+    analyzer = AttendanceAnalyzer(processor.store)
+    analyzer.print_insights(analyzer.generate_insights())
     for lecture_id in processor.store.distinct_lecture_ids():
         stats = processor.get_attendance_stats(lecture_id)
         logger.info("%s: %d unique attendees, %d records", lecture_id,

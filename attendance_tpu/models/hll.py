@@ -186,6 +186,23 @@ def hll_merge(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.maximum(a, b)
 
 
+def best_histogram(regs: jax.Array, precision: int = 14) -> jax.Array:
+    """Histogram via the fastest available path for the current backend.
+
+    On TPU the Pallas compare-reduce kernel (ops.pallas_kernels) beats
+    XLA's one-hot scatter-add bincount; on CPU the interpreter overhead
+    inverts that, so the XLA path stays default there.
+    """
+    if jax.default_backend() != "cpu":
+        try:
+            from attendance_tpu.ops.pallas_kernels import (
+                hll_histogram_pallas)
+            return hll_histogram_pallas(regs, precision)
+        except Exception:  # pragma: no cover - mosaic regression fallback
+            pass
+    return hll_histogram(regs, precision)
+
+
 class HyperLogLog:
     """Object shell over the functional kernels.
 
@@ -248,8 +265,8 @@ class HyperLogLog:
         idx = self._bank_of.get(name)
         if idx is None:
             return 0
-        hist = np.asarray(hll_histogram(self.regs[idx:idx + 1],
-                                        self.precision))[0]
+        hist = np.asarray(best_histogram(self.regs[idx:idx + 1],
+                                         self.precision))[0]
         return int(round(estimate_from_histogram(hist, self.precision)))
 
     def count_union(self, names) -> int:
@@ -260,7 +277,7 @@ class HyperLogLog:
         merged = self.regs[idxs[0]]
         for i in idxs[1:]:
             merged = hll_merge(merged, self.regs[i])
-        hist = np.asarray(hll_histogram(merged[None, :], self.precision))[0]
+        hist = np.asarray(best_histogram(merged[None, :], self.precision))[0]
         return int(round(estimate_from_histogram(hist, self.precision)))
 
     def keys(self):

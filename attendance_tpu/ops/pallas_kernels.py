@@ -1,0 +1,235 @@
+"""Hand-written Pallas TPU kernels for the sketch hot ops (SURVEY.md §7.3).
+
+Two kernels where a hand layout beats XLA's general scatter/gather:
+
+* ``bloom_contains_packed`` — blocked-Bloom membership over a
+  **bit-packed, transposed** filter. The XLA path stores one byte per bit
+  (8x the memory) and issues k independent byte-gathers per key. Here the
+  filter lives as ``uint32[16, num_blocks]`` (row w = word w of every
+  512-bit block), so ONE lane-gather per word row —
+  ``take_along_axis(axis=1)``, the gather direction Mosaic supports —
+  fetches each key's entire 64-byte block into registers; the k probes
+  then resolve with pure VPU shifts/masks, no further memory traffic.
+
+  Measured Mosaic limitation (probed on a v5e, jax 0.9): the underlying
+  ``tpu.dynamic_gather`` only resolves indices within a single native
+  (8, 128) lane tile — a 256-lane table already fails to compile. The
+  compiled TPU path is therefore limited to 128-block (~5.9k-capacity
+  at eps=0.01) filters: real as a per-gate micro-roster, but the
+  general path stays on XLA, whose gather emitter handles arbitrary
+  widths and already sustains ~21B ev/s on one chip (bench.py). This is
+  the right split: hand-write what the compiler can't schedule, keep
+  the compiler where its lowering is already optimal.
+
+* ``hll_histogram_pallas`` — register histogram per bank via
+  compare-and-sum over the 52 possible register values (pure VPU
+  reductions) instead of XLA's one-hot scatter-add bincount. No scatter,
+  no atomics; the whole PFCOUNT prep is data-parallel.
+
+Both kernels run under ``interpret=True`` on CPU (hermetic tests).
+
+The HLL *update* (scatter-max) stays on the XLA path: Mosaic has no
+vector scatter, and the XLA scatter-max is not the bottleneck.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from attendance_tpu.models.bloom import BLOCK_BITS, BloomParams
+from attendance_tpu.ops.murmur3 import (
+    SEED_BLOCK, SEED_BLOOM_A, SEED_BLOOM_B)
+
+WORDS_PER_BLOCK = BLOCK_BITS // 32  # 16 uint32 words = one 512-bit block
+
+# Mosaic's take_along_axis lowering requires the index array to have the
+# SAME shape as the gathered table, so the kernel processes keys in tiles
+# exactly as wide as the (lane-padded) table; and its dynamic_gather only
+# spans one native 128-lane tile (see module docstring), so the compiled
+# path caps the table at 128 lanes.
+_MIN_TILE_LANES = 128
+MAX_COMPILED_BLOCKS = 128
+
+
+def _on_cpu() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def pack_bits_transposed(bits: jax.Array) -> jax.Array:
+    """uint8[m_bits] (one byte per bit) -> uint32[16, num_blocks_padded].
+
+    Word layout matches bloom_positions' blocked probing: bit ``off`` of
+    block ``b`` lives at word ``off >> 5``, bit ``off & 31``. num_blocks
+    is padded to a lane multiple (128) for the kernel's gather.
+    """
+    m_bits = bits.shape[0]
+    assert m_bits % BLOCK_BITS == 0
+    num_blocks = m_bits // BLOCK_BITS
+    padded_blocks = ((num_blocks + _MIN_TILE_LANES - 1)
+                     // _MIN_TILE_LANES) * _MIN_TILE_LANES
+    # [num_blocks, 16 words, 32 bits] -> weight bits -> sum -> transpose
+    b3 = bits.reshape(num_blocks, WORDS_PER_BLOCK, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    words = jnp.sum(b3 * weights[None, None, :], axis=-1)  # [blocks, 16]
+    out = jnp.zeros((WORDS_PER_BLOCK, padded_blocks), jnp.uint32)
+    return out.at[:, :num_blocks].set(words.T)
+
+
+def kernel_tile_width(packed: jax.Array) -> int:
+    """Keys per kernel step: 8 sublane rows of the table's lane width."""
+    return _SUBLANES * packed.shape[1]
+
+
+def _murmur32(k, seed):
+    """MurmurHash3_x86_32 of one 4-byte block — VPU-only ops, usable
+    inside a Pallas kernel (mirror of ops.murmur3.murmur3_u32)."""
+    C1 = jnp.uint32(0xCC9E2D51)
+    C2 = jnp.uint32(0x1B873593)
+    k = k * C1
+    k = (k << jnp.uint32(15)) | (k >> jnp.uint32(17))
+    k = k * C2
+    h = jnp.uint32(seed) ^ k
+    h = (h << jnp.uint32(13)) | (h >> jnp.uint32(19))
+    h = h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+    h = h ^ jnp.uint32(4)
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+_SUBLANES = 8  # rows per key tile (Mosaic min sublane granularity)
+
+
+def _bloom_kernel(packed_ref, keys_ref, out_ref, *, num_blocks: int,
+                  k: int):
+    table = packed_ref[:]                   # (16, W)
+    width = table.shape[1]
+    keys = keys_ref[:]                      # (8, W) uint32
+    h1 = _murmur32(keys, SEED_BLOOM_A)
+    h2 = _murmur32(keys, SEED_BLOOM_B) | jnp.uint32(1)
+    h3 = _murmur32(keys, SEED_BLOCK) | jnp.uint32(1)
+    block = (h1 % jnp.uint32(num_blocks)).astype(jnp.int32)  # (8, W)
+
+    word_sel = jax.lax.broadcasted_iota(
+        jnp.uint32, (WORDS_PER_BLOCK, width), 0)
+    out = []
+    for r in range(_SUBLANES):  # static unroll over tile rows
+        # ONE gather resolves all 16 words of every key's 512-bit block
+        # in this row. Mosaic's lowering needs idx.shape == table.shape,
+        # hence one W-wide row of keys per gather.
+        idx = jnp.broadcast_to(block[r:r + 1, :], (WORDS_PER_BLOCK, width))
+        words = jnp.take_along_axis(table, idx, axis=1)  # (16, W)
+        acc = jnp.ones((1, width), jnp.uint32)
+        for j in range(k):  # static unroll -> pure VPU, no memory ops
+            off = ((h2[r:r + 1, :] + jnp.uint32(j) * h3[r:r + 1, :])
+                   & jnp.uint32(BLOCK_BITS - 1))
+            w_idx = off >> jnp.uint32(5)    # (1, W) in [0, 16)
+            bit = off & jnp.uint32(31)
+            # 16-way select, no gather. The sum runs in int32 (Mosaic has
+            # no unsigned reductions); exactly one addend is nonzero, so
+            # the bit pattern is preserved through the round-trip.
+            word = jnp.sum(
+                jnp.where(word_sel == w_idx, words,
+                          jnp.uint32(0)).astype(jnp.int32),
+                axis=0, keepdims=True).astype(jnp.uint32)
+            acc = acc & ((word >> bit) & jnp.uint32(1))
+        out.append(acc)
+    out_ref[:] = jnp.concatenate(out, axis=0).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("num_blocks", "k"))
+def _bloom_contains_call(packed, keys2d, *, num_blocks: int, k: int):
+    rows, width = keys2d.shape
+    kern = functools.partial(_bloom_kernel, num_blocks=num_blocks, k=k)
+    return pl.pallas_call(
+        kern,
+        grid=(rows // _SUBLANES,),
+        in_specs=[
+            pl.BlockSpec(packed.shape, lambda i: (0, 0),
+                         memory_space=pltpu.ANY
+                         if _on_cpu() else pltpu.VMEM),
+            pl.BlockSpec((_SUBLANES, width), lambda i: (i, 0),
+                         memory_space=pltpu.ANY
+                         if _on_cpu() else pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_SUBLANES, width), lambda i: (i, 0),
+                               memory_space=pltpu.ANY
+                               if _on_cpu() else pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(keys2d.shape, jnp.uint8),
+        interpret=_on_cpu(),
+    )(packed, keys2d)
+
+
+def bloom_contains_packed(packed: jax.Array, keys: jax.Array,
+                          params: BloomParams) -> jax.Array:
+    """Batched BF.EXISTS over a packed transposed blocked filter.
+
+    keys length must be a multiple of the table's lane width
+    (``kernel_tile_width(packed)``); callers pad. Returns bool[B]. Only
+    valid for params.layout == "blocked".
+    """
+    if params.layout != "blocked":
+        raise ValueError("packed kernel requires the blocked layout")
+    num_blocks = params.m_bits // BLOCK_BITS
+    width = packed.shape[1]
+    if width > MAX_COMPILED_BLOCKS and not _on_cpu():
+        raise ValueError(
+            f"{width}-lane table exceeds Mosaic's single-tile "
+            f"dynamic_gather ({MAX_COMPILED_BLOCKS} lanes); use the XLA "
+            "path (models.bloom.bloom_contains) for large filters")
+    tile = _SUBLANES * width
+    b = keys.shape[0]
+    assert b % tile == 0, f"batch {b} not a multiple of tile {tile}"
+    keys2d = keys.astype(jnp.uint32).reshape(-1, width)
+    out = _bloom_contains_call(packed, keys2d,
+                               num_blocks=num_blocks, k=params.k)
+    return out.reshape(-1) == jnp.uint8(1)
+
+
+# ---------------------------------------------------------------------------
+# HLL histogram: compare-and-sum instead of scatter-add bincount
+# ---------------------------------------------------------------------------
+
+def _hist_kernel(regs_ref, out_ref, *, num_values: int):
+    regs = regs_ref[:].astype(jnp.int32)     # (num_banks, m)
+    cols = []
+    for v in range(num_values):              # static unroll: VPU reduces
+        cols.append(jnp.sum(
+            jnp.where(regs == v, jnp.int32(1), jnp.int32(0)),
+            axis=1, keepdims=True))          # (num_banks, 1)
+    out_ref[:] = jnp.concatenate(cols, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("num_values",))
+def _hist_call(regs, *, num_values: int):
+    num_banks, m = regs.shape
+    kern = functools.partial(_hist_kernel, num_values=num_values)
+    return pl.pallas_call(
+        kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY
+                               if _on_cpu() else pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY
+                               if _on_cpu() else pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((num_banks, num_values), jnp.int32),
+        interpret=_on_cpu(),
+    )(regs)
+
+
+def hll_histogram_pallas(regs: jax.Array, precision: int = 14) -> jax.Array:
+    """Register-value histogram per bank: int32[num_banks, q+2].
+
+    Drop-in replacement for models.hll.hll_histogram (vmap'd bincount =
+    one-hot scatter-add in XLA) built from comparisons and reductions
+    only — the shape of compute the VPU is best at.
+    """
+    q = 64 - precision
+    return _hist_call(regs, num_values=q + 2)

@@ -75,11 +75,13 @@ class ShardedSketchEngine:
         self.precision = precision
         self.params = params or derive_bloom_params(
             capacity, error_rate, layout)
-        # m_bits must split evenly into sp slices of whole blocks.
+        # The ALLOCATION is padded so it splits evenly into sp slices of
+        # whole blocks, but the hash modulus stays params.m_bits — so a
+        # key's probe positions (and therefore every validity bit) are
+        # identical on every mesh shape; the pad blocks are simply never
+        # addressed.
         chunk = self.sp * BLOCK_BITS
-        m = ((self.params.m_bits + chunk - 1) // chunk) * chunk
-        if m != self.params.m_bits:
-            self.params = self.params._replace(m_bits=m)
+        self.m_alloc = ((self.params.m_bits + chunk - 1) // chunk) * chunk
         self.m_regs = 1 << precision
         if self.m_regs % self.sp:
             raise ValueError(f"sp={self.sp} must divide {self.m_regs}")
@@ -88,7 +90,7 @@ class ShardedSketchEngine:
         bits_sharding = NamedSharding(mesh, P("sp"))
         regs_sharding = NamedSharding(mesh, P(None, "sp"))
         self.bits = jax.device_put(
-            jnp.zeros((self.params.m_bits,), jnp.uint8), bits_sharding)
+            jnp.zeros((self.m_alloc,), jnp.uint8), bits_sharding)
         self.regs = jax.device_put(
             jnp.zeros((num_banks, self.m_regs), jnp.uint8), regs_sharding)
         self._build_kernels()
@@ -98,7 +100,7 @@ class ShardedSketchEngine:
         mesh = self.mesh
         params = self.params
         precision = self.precision
-        m_local = params.m_bits // self.sp
+        m_local = self.m_alloc // self.sp
         regs_local = self.m_regs // self.sp
 
         def local_contains(bits_loc, keys):

@@ -175,6 +175,7 @@ class AttendanceProcessor:
         self.setup_bloom_filter()
         t_start = time.perf_counter()
         idle_since = time.monotonic()
+        consecutive_failures = 0
         try:
             while True:
                 msgs = self._collect_batch()
@@ -206,18 +207,22 @@ class AttendanceProcessor:
                             self.consumer.negative_acknowledge(m)
                 try:
                     self.process_events(events)
+                    consecutive_failures = 0
                 except Exception:
                     # Whole-batch nack -> broker redelivery; idempotent
-                    # sinks make the replay safe (SURVEY.md §5).
+                    # sinks make the replay safe (SURVEY.md §5). Unlike
+                    # decode poison, processing failures are usually
+                    # transient backend faults, so: exponential backoff
+                    # before the nack and NO dead-lettering — well-formed
+                    # events are never dropped (the reference likewise
+                    # retries forever, attendance_processor.py:134-136).
                     logger.exception("Error processing batch; nacking")
                     self.metrics.nacked_batches += 1
+                    consecutive_failures += 1
+                    time.sleep(min(0.05 * 2 ** min(consecutive_failures, 6),
+                                   2.0))
                     for m in good_msgs:
-                        if (m.redelivery_count
-                                >= self.config.max_redeliveries):
-                            self.metrics.dead_lettered += 1
-                            self.consumer.acknowledge(m)
-                        else:
-                            self.consumer.negative_acknowledge(m)
+                        self.consumer.negative_acknowledge(m)
                     continue
                 # Ack strictly after sketch + store writes committed
                 # (reference attendance_processor.py:132).

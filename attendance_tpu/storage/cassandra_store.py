@@ -1,0 +1,112 @@
+"""Real Cassandra event store (import-gated).
+
+Adapter over cassandra-driver reproducing the reference's schema and
+queries exactly: keyspace + ``attendance`` table DDL (reference
+attendance_processor.py:53-72), per-event INSERT columns (reference
+attendance_processor.py:116-124), ``SELECT DISTINCT lecture_id`` and the
+per-lecture filtered scan (reference attendance_analysis.py:22-39). Only
+imported when ``--storage-backend=cassandra`` is selected. Batched writes
+use concurrent async INSERTs rather than the reference's one blocking
+round-trip per event.
+
+Parity note: like the reference's table, ``event_type`` is not persisted —
+the reference drops it at INSERT time (attendance_processor.py:116-124
+stores only student_id, lecture_id, timestamp, is_valid); scans return
+rows with event_type="entry" as a placeholder.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Iterable, List
+
+from attendance_tpu.storage.memory_store import AttendanceRow
+
+try:
+    from cassandra.cluster import Cluster
+    HAVE_CASSANDRA = True
+except ImportError:  # pragma: no cover - environment without the driver
+    Cluster = None
+    HAVE_CASSANDRA = False
+
+_CONCURRENCY = 128  # in-flight async INSERTs per batch
+
+
+class CassandraEventStore:
+    def __init__(self, config):
+        if not HAVE_CASSANDRA:
+            raise RuntimeError(
+                "storage_backend='cassandra' requires cassandra-driver")
+        self.keyspace = config.cassandra_keyspace
+        self.cluster = Cluster(list(config.cassandra_hosts))
+        self.session = self.cluster.connect()
+        self._setup()
+        self._insert_stmt = self.session.prepare(
+            "INSERT INTO attendance (student_id, lecture_id, timestamp, "
+            "is_valid) VALUES (?, ?, ?, ?)")
+
+    def _setup(self) -> None:
+        self.session.execute(
+            f"CREATE KEYSPACE IF NOT EXISTS {self.keyspace} WITH "
+            "replication = {'class': 'SimpleStrategy', "
+            "'replication_factor': 1}")
+        self.session.set_keyspace(self.keyspace)
+        self.session.execute(
+            "CREATE TABLE IF NOT EXISTS attendance ("
+            " student_id int, lecture_id text, timestamp timestamp,"
+            " is_valid boolean,"
+            " PRIMARY KEY ((lecture_id), timestamp, student_id))")
+
+    # -- write path ---------------------------------------------------------
+    def insert(self, row: AttendanceRow) -> None:
+        self.insert_batch([row])
+
+    def insert_batch(self, rows: Iterable[AttendanceRow]) -> int:
+        rows = list(rows)
+        futures = []
+        for row in rows:
+            ts = datetime.fromisoformat(row.timestamp)
+            futures.append(self.session.execute_async(
+                self._insert_stmt,
+                (row.student_id, row.lecture_id, ts, row.is_valid)))
+            if len(futures) >= _CONCURRENCY:
+                for f in futures:
+                    f.result()
+                futures.clear()
+        for f in futures:
+            f.result()
+        return len(rows)
+
+    # -- read path ----------------------------------------------------------
+    def distinct_lecture_ids(self) -> List[str]:
+        rows = self.session.execute(
+            "SELECT DISTINCT lecture_id FROM attendance")
+        return sorted(r.lecture_id for r in rows)
+
+    def scan_lecture(self, lecture_id: str) -> List[AttendanceRow]:
+        rows = self.session.execute(
+            "SELECT student_id, lecture_id, timestamp, is_valid "
+            "FROM attendance WHERE lecture_id = %s ALLOW FILTERING",
+            (lecture_id,))
+        return [AttendanceRow(student_id=r.student_id,
+                              timestamp=r.timestamp.isoformat(),
+                              lecture_id=r.lecture_id,
+                              is_valid=r.is_valid,
+                              event_type="entry")
+                for r in rows]
+
+    def scan_all(self) -> List[AttendanceRow]:
+        out: List[AttendanceRow] = []
+        for lecture_id in self.distinct_lecture_ids():
+            out.extend(self.scan_lecture(lecture_id))
+        return out
+
+    def count(self) -> int:
+        row = self.session.execute("SELECT COUNT(*) FROM attendance").one()
+        return int(row[0])
+
+    def truncate(self) -> None:
+        self.session.execute("TRUNCATE attendance")
+
+    def close(self) -> None:
+        self.cluster.shutdown()

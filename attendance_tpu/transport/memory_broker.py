@@ -49,12 +49,16 @@ class Message:
 
 class _Subscription:
     """One named subscription on a topic: a shared pending queue plus an
-    in-flight (delivered, unacked) map — Pulsar Shared subscription."""
+    in-flight (delivered, unacked) map — Pulsar Shared subscription.
+    In-flight entries record the owning consumer so a consumer close only
+    requeues ITS unacked messages, not those delivered to still-live
+    competing consumers (Pulsar crash-takeover semantics)."""
 
     def __init__(self, name: str):
         self.name = name
         self.pending: Deque[Tuple[int, bytes, int]] = deque()
-        self.inflight: Dict[int, Tuple[bytes, int]] = {}
+        # message_id -> (payload, redeliveries, owner consumer id)
+        self.inflight: Dict[int, Tuple[bytes, int, int]] = {}
         self.cond = threading.Condition()
 
     def enqueue(self, message_id: int, data: bytes, redeliveries: int = 0):
@@ -62,7 +66,8 @@ class _Subscription:
             self.pending.append((message_id, data, redeliveries))
             self.cond.notify()
 
-    def receive(self, timeout_s: Optional[float]) -> Message:
+    def receive(self, timeout_s: Optional[float],
+                owner: int) -> Message:
         deadline = (None if timeout_s is None
                     else time.monotonic() + timeout_s)
         with self.cond:
@@ -78,7 +83,7 @@ class _Subscription:
                         f"no message within {timeout_s}s on {self.name!r}")
                 self.cond.wait(remaining)
             mid, data, redeliveries = self.pending.popleft()
-            self.inflight[mid] = (data, redeliveries)
+            self.inflight[mid] = (data, redeliveries, owner)
             return Message(data, mid, redeliveries)
 
     def acknowledge(self, message_id: int) -> None:
@@ -89,17 +94,21 @@ class _Subscription:
         with self.cond:
             entry = self.inflight.pop(message_id, None)
             if entry is not None:
-                data, redeliveries = entry
+                data, redeliveries, _ = entry
                 self.pending.append((message_id, data, redeliveries + 1))
                 self.cond.notify()
 
-    def requeue_inflight(self) -> None:
-        """Crash takeover: return every unacked message to the queue."""
+    def requeue_inflight(self, owner: int) -> None:
+        """Crash takeover: return the closing consumer's own unacked
+        messages to the queue (other consumers' deliveries stay theirs)."""
         with self.cond:
-            for mid, (data, redeliveries) in self.inflight.items():
+            mine = [(mid, d, r) for mid, (d, r, o) in self.inflight.items()
+                    if o == owner]
+            for mid, data, redeliveries in mine:
+                del self.inflight[mid]
                 self.pending.append((mid, data, redeliveries + 1))
-            self.inflight.clear()
-            self.cond.notify_all()
+            if mine:
+                self.cond.notify_all()
 
     def backlog(self) -> int:
         with self.cond:
@@ -182,16 +191,20 @@ class MemoryProducer:
         self._closed = True
 
 
+_consumer_ids = itertools.count()
+
+
 class MemoryConsumer:
     def __init__(self, sub: _Subscription):
         self._sub = sub
         self._closed = False
+        self._id = next(_consumer_ids)
 
     def receive(self, timeout_millis: Optional[int] = None) -> Message:
         if self._closed:
             raise RuntimeError("consumer closed")
         timeout_s = None if timeout_millis is None else timeout_millis / 1e3
-        return self._sub.receive(timeout_s)
+        return self._sub.receive(timeout_s, self._id)
 
     def acknowledge(self, msg: Message) -> None:
         self._sub.acknowledge(msg.message_id)
@@ -205,7 +218,7 @@ class MemoryConsumer:
     def close(self) -> None:
         if not self._closed:
             self._closed = True
-            self._sub.requeue_inflight()
+            self._sub.requeue_inflight(self._id)
 
 
 class MemoryClient:

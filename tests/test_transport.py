@@ -82,6 +82,28 @@ def test_consumer_close_requeues_inflight():
     assert m.redelivery_count == 1
 
 
+def test_close_requeues_only_own_inflight():
+    """Closing one competing consumer must not steal/redeliver messages
+    delivered to a still-live consumer (Pulsar crash-takeover scope)."""
+    client = make_client()
+    producer = client.create_producer("t")
+    c1 = client.subscribe("t", "sub")
+    c2 = client.subscribe("t", "sub")
+    producer.send(b"a")
+    producer.send(b"b")
+    m1 = c1.receive(timeout_millis=100)
+    m2 = c2.receive(timeout_millis=100)  # in-flight on live c2
+    c1.close()  # requeues only m1
+    m1b = c2.receive(timeout_millis=100)
+    assert m1b.data() == m1.data()
+    assert m1b.redelivery_count == 1
+    c2.acknowledge(m1b)
+    c2.acknowledge(m2)  # original delivery still acknowledgeable
+    assert c2.backlog() == 0
+    with pytest.raises(ReceiveTimeout):
+        c2.receive(timeout_millis=10)
+
+
 def test_cross_thread_delivery():
     client = make_client()
     consumer = client.subscribe("t", "sub")
