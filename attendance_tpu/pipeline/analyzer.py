@@ -30,6 +30,18 @@ class AttendanceAnalyzer:
         self.store = event_store
 
     def _fetch_attendance_data(self) -> pd.DataFrame:
+        if hasattr(self.store, "to_dataframe"):
+            # Columnar store (fused path): reconstruct the row-store view.
+            df = self.store.to_dataframe()
+            if df.empty:
+                logger.warning("No attendance records found")
+                return pd.DataFrame()
+            return pd.DataFrame({
+                "student_id": df["student_id"].astype("int64"),
+                "lecture_id": "LECTURE_" + df["lecture_day"].astype(str),
+                "timestamp": pd.to_datetime(df["micros"], unit="us"),
+                "is_valid": df["is_valid"].astype(bool),
+            })
         rows = self.store.scan_all()
         if not rows:
             logger.warning("No attendance records found")

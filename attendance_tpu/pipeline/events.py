@@ -128,7 +128,14 @@ def encode_binary_batch(events: Sequence[AttendanceEvent]) -> bytes:
 
 
 def decode_binary_batch(data: bytes) -> Dict[str, np.ndarray]:
-    """Zero-copy columnar decode of one binary frame -> column arrays."""
+    """Zero-copy columnar decode of one binary frame -> column arrays.
+
+    Accepts both the interleaved record format (ATB1) and the planar
+    format (ATB2); prefer planar on the hot path — its column views are
+    contiguous, so the device transfer needs no host gather/copy first.
+    """
+    if data.startswith(PLANAR_MAGIC):
+        return decode_planar_batch(data)
     if not data.startswith(BINARY_MAGIC):
         raise ValueError("not a binary event frame")
     rec = np.frombuffer(data, dtype=BINARY_DTYPE, offset=len(BINARY_MAGIC))
@@ -138,6 +145,55 @@ def decode_binary_batch(data: bytes) -> Dict[str, np.ndarray]:
         "micros": rec["micros"],
         "is_valid": (rec["flags"] & 1).astype(bool),
         "event_type": ((rec["flags"] >> 1) & 1).astype(np.int8),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Planar binary format: contiguous column blocks, zero-copy views
+# ---------------------------------------------------------------------------
+
+PLANAR_MAGIC = b"ATB2"
+# layout: magic | u32 n | student_id u32[n] | lecture_day u32[n]
+#         | micros i64[n] | flags u8[n]
+
+
+def encode_planar_batch(cols: Dict[str, np.ndarray]) -> bytes:
+    """Pack column arrays into one planar frame (the hot-path producer)."""
+    n = len(cols["student_id"])
+    flags = (np.asarray(cols["is_valid"]).astype(np.uint8)
+             | (np.asarray(cols["event_type"]).astype(np.uint8) << 1))
+    parts = [PLANAR_MAGIC, np.uint32(n).tobytes(),
+             np.ascontiguousarray(cols["student_id"],
+                                  dtype=np.uint32).tobytes(),
+             np.ascontiguousarray(cols["lecture_day"],
+                                  dtype=np.uint32).tobytes(),
+             np.ascontiguousarray(cols["micros"],
+                                  dtype=np.int64).tobytes(),
+             flags.tobytes()]
+    return b"".join(parts)
+
+
+def decode_planar_batch(data: bytes) -> Dict[str, np.ndarray]:
+    """Zero-copy decode: every column is a contiguous buffer view."""
+    if not data.startswith(PLANAR_MAGIC):
+        raise ValueError("not a planar event frame")
+    off = len(PLANAR_MAGIC)
+    (n,) = np.frombuffer(data, np.uint32, count=1, offset=off)
+    n = int(n)
+    off += 4
+    student = np.frombuffer(data, np.uint32, count=n, offset=off)
+    off += 4 * n
+    day = np.frombuffer(data, np.uint32, count=n, offset=off)
+    off += 4 * n
+    micros = np.frombuffer(data, np.int64, count=n, offset=off)
+    off += 8 * n
+    flags = np.frombuffer(data, np.uint8, count=n, offset=off)
+    return {
+        "student_id": student,
+        "lecture_day": day,
+        "micros": micros,
+        "is_valid": (flags & 1).astype(bool),
+        "event_type": ((flags >> 1) & 1).astype(np.int8),
     }
 
 

@@ -1,0 +1,229 @@
+"""Fused high-rate processor: the north-star hot path end to end.
+
+Per micro-batch: one bulk binary frame from the broker -> zero-copy
+columnar decode (np.frombuffer) -> ONE fused device dispatch
+(Bloom-validate + HLL-count, models.fused) -> columnar side-store append
+-> ack. Replaces the reference's 3-RTT-per-event loop (reference
+attendance_processor.py:100-136) at the other end of the batching
+spectrum from AttendanceProcessor (which keeps the JSON wire format and
+the generic SketchStore API).
+
+Ack ordering under pipelining (SURVEY.md §7 hard part f): dispatches are
+enqueued asynchronously so host decode of batch N+1 overlaps device
+execution of batch N, but a frame is acknowledged only after its batch's
+device outputs are materialized — an in-flight deque of (frame, outputs)
+drains as results become ready, preserving the reference's
+ack-after-commit at-least-once contract (attendance_processor.py:132).
+Replays after a crash are harmless: scatter-set/scatter-max sketches and
+the read-time-dedup columnar store are all idempotent (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from attendance_tpu.config import Config
+from attendance_tpu.models.bloom import bloom_add
+from attendance_tpu.models.fused import init_state, make_jitted_step_packed
+from attendance_tpu.models.hll import (
+    best_histogram, estimate_from_histogram)
+from attendance_tpu.pipeline.events import decode_binary_batch
+from attendance_tpu.pipeline.processor import ProcessorMetrics
+from attendance_tpu.storage.columnar_store import ColumnarEventStore
+from attendance_tpu.transport import make_client
+from attendance_tpu.transport.memory_broker import ReceiveTimeout
+
+logger = logging.getLogger(__name__)
+
+_INFLIGHT_DEPTH = 8  # dispatched-but-unacked batches before forcing a sync
+
+
+class FusedPipeline:
+    SUBSCRIPTION = "attendance_fused"
+
+    def __init__(self, config: Optional[Config] = None, *,
+                 client=None, store: Optional[ColumnarEventStore] = None,
+                 num_banks: int = 256):
+        self.config = config or Config()
+        self.client = client or make_client(self.config)
+        self.consumer = self.client.subscribe(
+            self.config.pulsar_topic, self.SUBSCRIPTION)
+        self.store = store or ColumnarEventStore()
+        self.state, self.params = init_state(
+            capacity=self.config.bloom_filter_capacity,
+            error_rate=self.config.bloom_filter_error_rate,
+            layout=self.config.bloom_layout
+            if self.config.bloom_layout == "blocked" else "blocked",
+            num_banks=num_banks,
+            precision=self.config.hll_precision)
+        self._step = make_jitted_step_packed(self.params,
+                                             self.config.hll_precision)
+        self._preload = jax.jit(
+            lambda bits, keys: bloom_add(bits, keys, self.params),
+            donate_argnums=(0,))
+        self._bank_of: Dict[int, int] = {}
+        # Dense day->bank lookup: maps days in [base, base + LUT) with one
+        # O(n) fancy-index instead of an O(n log n) np.unique per batch.
+        self._day_base: Optional[int] = None
+        self._day_lut = np.full(self._LUT_SIZE, -1, np.int32)
+        self.metrics = ProcessorMetrics()
+        self._inflight = deque()
+
+    _LUT_SIZE = 1 << 14  # covers ~44 years of calendar days from base
+
+    # -- roster -------------------------------------------------------------
+    def preload(self, keys) -> None:
+        keys = np.asarray(keys, dtype=np.uint32)
+        self.state = self.state._replace(bloom_bits=self._preload(
+            self.state.bloom_bits, jax.numpy.asarray(keys)))
+
+    # -- bank mapping -------------------------------------------------------
+    def _register_day(self, day: int) -> int:
+        bank = self._bank_of.get(day)
+        if bank is not None:
+            return bank
+        bank = len(self._bank_of)
+        if bank >= self.state.hll_regs.shape[0]:
+            # Double the bank array (rare; one recompile per size).
+            regs = self.state.hll_regs
+            grown = jax.numpy.zeros(
+                (regs.shape[0] * 2, regs.shape[1]), regs.dtype)
+            self.state = self.state._replace(
+                hll_regs=grown.at[:regs.shape[0]].set(regs))
+        self._bank_of[day] = bank
+        if self._day_base is not None:
+            off = day - self._day_base
+            if 0 <= off < self._LUT_SIZE:
+                self._day_lut[off] = bank
+        return bank
+
+    def _rebuild_lut(self, base: int) -> None:
+        self._day_base = base
+        self._day_lut.fill(-1)
+        for day, bank in self._bank_of.items():
+            off = day - base
+            if 0 <= off < self._LUT_SIZE:
+                self._day_lut[off] = bank
+
+    def _banks_for(self, lecture_days: np.ndarray) -> np.ndarray:
+        """Vectorized day->bank: one fancy-index through the dense LUT;
+        unseen/out-of-window days take the scalar slow path (rare —
+        calendar days are few and clustered)."""
+        days = lecture_days.astype(np.int64)
+        if self._day_base is None:
+            self._rebuild_lut(int(days.min()))
+        off = days - self._day_base
+        in_range = (off >= 0) & (off < self._LUT_SIZE)
+        banks = np.full(len(days), -1, np.int32)
+        idx = np.where(in_range, off, 0)
+        banks = np.where(in_range, self._day_lut[idx], -1)
+        misses = banks < 0
+        if misses.any():
+            miss_days = np.unique(days[misses])
+            if int(miss_days.min()) < self._day_base:
+                self._rebuild_lut(int(miss_days.min()))
+            for day in miss_days.tolist():
+                self._register_day(int(day))
+            # re-resolve only the missed lanes
+            moff = days[misses] - self._day_base
+            mok = (moff >= 0) & (moff < self._LUT_SIZE)
+            fixed = np.where(mok, self._day_lut[np.where(mok, moff, 0)], -1)
+            still = fixed < 0
+            if still.any():  # outside the LUT window: scalar map
+                vals = days[misses][still]
+                fixed[still] = [self._bank_of[int(d)]
+                                for d in vals.tolist()]
+            banks[misses] = fixed
+        return banks
+
+    # -- hot loop -----------------------------------------------------------
+    def process_frame(self, data: bytes):
+        """Dispatch one bulk binary frame; returns the async validity."""
+        t0 = time.perf_counter()
+        cols = decode_binary_batch(data)
+        n = len(cols["student_id"])
+        if n == 0:
+            return None
+        padded = 256
+        while padded < n:
+            padded *= 2
+        # ONE combined transfer: row 0 keys, row 1 bank ids (-1 pads).
+        packed = np.empty((2, padded), np.uint32)
+        packed[0, :n] = cols["student_id"]
+        packed[0, n:] = 0
+        packed[1, :n] = self._banks_for(
+            cols["lecture_day"]).view(np.uint32)
+        packed[1, n:] = np.uint32(0xFFFFFFFF)  # bank -1: dropped lanes
+        self.state, valid = self._step(self.state,
+                                       jax.numpy.asarray(packed))
+        valid_n = valid[:n]
+        self.store.insert_columns({**cols, "is_valid": valid_n})
+        self.metrics.batches += 1
+        self.metrics.events += n
+        self.metrics.batch_sizes.append(n)
+        self.metrics.device_seconds += time.perf_counter() - t0
+        return valid_n
+
+    def _drain_inflight(self, force: bool) -> None:
+        while self._inflight:
+            msg, valid = self._inflight[0]
+            if valid is not None and not force:
+                try:
+                    ready = valid.is_ready()
+                except AttributeError:  # non-jax array (empty frame)
+                    ready = True
+                if not ready:
+                    break
+            if valid is not None:
+                jax.block_until_ready(valid)
+            self.consumer.acknowledge(msg)
+            self._inflight.popleft()
+
+    def run(self, max_events: Optional[int] = None,
+            idle_timeout_s: float = 1.0) -> None:
+        t_start = time.perf_counter()
+        idle_since = time.monotonic()
+        while True:
+            try:
+                msg = self.consumer.receive(timeout_millis=50)
+            except ReceiveTimeout:
+                self._drain_inflight(force=True)
+                if time.monotonic() - idle_since > idle_timeout_s:
+                    break
+                continue
+            idle_since = time.monotonic()
+            try:
+                valid = self.process_frame(msg.data())
+            except Exception:
+                logger.exception("Bad frame; nacking")
+                self.metrics.nacked_batches += 1
+                self.consumer.negative_acknowledge(msg)
+                continue
+            self._inflight.append((msg, valid))
+            self._drain_inflight(force=len(self._inflight)
+                                 >= _INFLIGHT_DEPTH)
+            if max_events is not None and self.metrics.events >= max_events:
+                break
+        self._drain_inflight(force=True)
+        self.metrics.wall_seconds = time.perf_counter() - t_start
+
+    # -- queries ------------------------------------------------------------
+    def count(self, lecture_day: int) -> int:
+        bank = self._bank_of.get(int(lecture_day))
+        if bank is None:
+            return 0
+        hist = np.asarray(best_histogram(
+            self.state.hll_regs[bank:bank + 1],
+            self.config.hll_precision))[0]
+        return int(round(estimate_from_histogram(
+            hist, self.config.hll_precision)))
+
+    def cleanup(self) -> None:
+        self.client.close()
+        self.store.close()

@@ -1,0 +1,83 @@
+"""Bulk binary load generator for the fused path and the e2e benchmark.
+
+Produces the same event *population* as the reference-parity generator
+(valid/invalid id ranges, per-lecture spread, invalid-attempt fraction —
+reference data_generator.py:53-54,80-81,140) but materialized directly as
+column arrays and shipped as bulk binary frames, skipping per-event
+Python and JSON entirely. This is the ingress the 50M-ev/s north star
+requires (SURVEY.md §7 hard part d: "host-side JSON decode becomes the
+new bottleneck — needs batched decode and binary framing").
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from attendance_tpu.pipeline.events import (
+    BINARY_DTYPE, BINARY_MAGIC, encode_planar_batch)
+
+_BASE_MICROS = 1_753_000_000_000_000  # an arbitrary 2025 epoch anchor
+
+
+def synth_columns(rng: np.random.Generator, batch: int,
+                  roster: np.ndarray, num_lectures: int,
+                  invalid_fraction: float = 0.1) -> dict:
+    """One micro-batch of synthetic swipe columns."""
+    valid = rng.random(batch) >= invalid_fraction
+    student = np.where(
+        valid,
+        roster[rng.integers(0, len(roster), batch)],
+        rng.integers(100_000, 1_000_000, batch).astype(np.uint32))
+    day = (20_260_701 + rng.integers(0, num_lectures, batch)).astype(
+        np.uint32)
+    micros = (_BASE_MICROS
+              + rng.integers(0, 86_400_000_000, batch)).astype(np.int64)
+    return {
+        "student_id": student.astype(np.uint32),
+        "lecture_day": day,
+        "micros": micros,
+        "is_valid": valid,  # generator ground truth (oracle only)
+        "event_type": (rng.random(batch) < 0.5).astype(np.int8),
+    }
+
+
+def frame_from_columns(cols: dict, planar: bool = True) -> bytes:
+    """Pack one micro-batch of columns into a bulk binary frame.
+
+    planar=True (default) emits the contiguous-column ATB2 format the
+    fused path decodes zero-copy; planar=False emits interleaved ATB1
+    records (kept for wire-compat tests)."""
+    if planar:
+        return encode_planar_batch(cols)
+    n = len(cols["student_id"])
+    rec = np.zeros(n, dtype=BINARY_DTYPE)
+    rec["student_id"] = cols["student_id"]
+    rec["lecture_day"] = cols["lecture_day"]
+    rec["micros"] = cols["micros"]
+    rec["flags"] = (cols["is_valid"].astype(np.uint8)
+                    | (cols["event_type"].astype(np.uint8) << 1))
+    return BINARY_MAGIC + rec.tobytes()
+
+
+def generate_frames(num_events: int, batch: int,
+                    roster_size: int = 100_000, num_lectures: int = 64,
+                    invalid_fraction: float = 0.1,
+                    seed: Optional[int] = 0,
+                    ) -> Tuple[np.ndarray, Iterator[bytes]]:
+    """(roster, iterator of bulk frames totalling num_events events)."""
+    rng = np.random.default_rng(seed)
+    roster = rng.choice(np.arange(10_000, 10_000 + 4 * roster_size,
+                                  dtype=np.uint32),
+                        size=roster_size, replace=False)
+
+    def frames():
+        left = num_events
+        while left > 0:
+            n = min(batch, left)
+            yield frame_from_columns(synth_columns(
+                rng, n, roster, num_lectures, invalid_fraction))
+            left -= n
+
+    return roster, frames()

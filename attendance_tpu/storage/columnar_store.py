@@ -1,0 +1,96 @@
+"""Columnar event store: the batched side-output for the fused hot path.
+
+At the north-star event rate the row-object store becomes the bottleneck:
+building one Python ``AttendanceRow`` per event costs ~1us each, i.e. a
+1M-event batch burns a second on the host while the device finishes in
+~50us. This store persists micro-batches as numpy column blocks with
+zero per-event Python — the TPU-native redesign of the reference's
+per-event Cassandra INSERT (reference attendance_processor.py:116-124;
+SURVEY.md §2.2 "writes move off the per-event critical path into the
+batched side-output").
+
+Semantics note: the row stores keep Cassandra's upsert-by-primary-key
+dedup; this store is append-only (replayed batches append duplicate
+blocks) and deduplicates lazily at read time, when blocks are compacted
+into a DataFrame — the same observable result with O(batch) write cost.
+Read-time dedup keeps the LAST occurrence of a primary key, matching
+Cassandra last-write-wins.
+"""
+
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+from typing import Dict, List
+
+import numpy as np
+import pandas as pd
+
+_COLS = ("student_id", "lecture_day", "micros", "is_valid", "event_type")
+
+
+class ColumnarEventStore:
+    """Append-only columnar store keyed by the binary codec's columns."""
+
+    def __init__(self):
+        self._blocks: List[Dict[str, np.ndarray]] = []
+        self._lock = threading.Lock()
+
+    # -- write path (the hot side-output) -----------------------------------
+    def insert_columns(self, cols: Dict[str, np.ndarray]) -> int:
+        """Append one micro-batch of column arrays (see events.BINARY_DTYPE
+        for the column set). Arrays are referenced, not copied — callers
+        must not mutate them afterwards. jax arrays are accepted as-is so
+        an async device result (the fused path's validity vector) never
+        forces a sync here; conversion happens lazily at read time."""
+        n = len(cols["student_id"])
+        block = {name: cols[name] for name in _COLS}
+        with self._lock:
+            self._blocks.append(block)
+        return n
+
+    # -- read path -----------------------------------------------------------
+    def to_dataframe(self, deduplicate: bool = True) -> pd.DataFrame:
+        """Compact all blocks into one DataFrame (analytics entry point)."""
+        with self._lock:
+            blocks = list(self._blocks)
+        if not blocks:
+            return pd.DataFrame(columns=list(_COLS))
+        df = pd.DataFrame({
+            name: np.concatenate([np.asarray(b[name]) for b in blocks])
+            for name in _COLS})
+        if deduplicate:
+            # Cassandra PK = (lecture, timestamp, student): last write wins.
+            df = df.drop_duplicates(
+                subset=["lecture_day", "micros", "student_id"], keep="last")
+        return df.reset_index(drop=True)
+
+    def count(self) -> int:
+        """Distinct primary keys (post-dedup), matching the row stores."""
+        return len(self.to_dataframe())
+
+    def distinct_lecture_days(self) -> List[int]:
+        df = self.to_dataframe(deduplicate=False)
+        return sorted(df["lecture_day"].unique().tolist())
+
+    # -- durability ----------------------------------------------------------
+    def save(self, path) -> None:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        df = self.to_dataframe()
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **{c: df[c].to_numpy() for c in _COLS})
+        tmp.replace(path)
+
+    def load(self, path) -> int:
+        with np.load(Path(path)) as data:
+            cols = {c: data[c] for c in _COLS}
+        return self.insert_columns(cols)
+
+    def truncate(self) -> None:
+        with self._lock:
+            self._blocks.clear()
+
+    def close(self) -> None:
+        pass

@@ -83,8 +83,52 @@ def bench_fused_step(batch_size: int, seconds: float, capacity: int,
     }
 
 
+def bench_e2e(batch_size: int, seconds: float, capacity: int,
+              num_banks: int) -> dict:
+    """Broker -> fused processor -> columnar store, wall-clock end to end.
+
+    Unlike bench_fused_step this includes the real ingress: binary frame
+    decode, bank mapping, padding, host->device transfer, ack-after-
+    commit bookkeeping, and the store side-output.
+    """
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(bloom_filter_capacity=capacity,
+                    transport_backend="memory")
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=num_banks)
+
+    # Size the run so the broker backlog covers `seconds` of processing.
+    num_events = int(seconds * 25e6)
+    roster, frames = generate_frames(num_events, batch_size,
+                                     roster_size=min(capacity, 1_000_000),
+                                     num_lectures=num_banks)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for frame in frames:
+        producer.send(frame)
+
+    # warmup one frame size compile
+    pipe.run(max_events=batch_size, idle_timeout_s=0.2)
+    pipe.metrics.events = 0
+    pipe.metrics.wall_seconds = 0.0
+
+    pipe.run(idle_timeout_s=0.5)
+    wall = pipe.metrics.wall_seconds
+    return {
+        "events_per_sec": pipe.metrics.events / wall if wall else 0.0,
+        "events": pipe.metrics.events,
+        "elapsed_s": wall,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="kernel", choices=["kernel", "e2e"])
     ap.add_argument("--batch-size", type=int, default=1 << 20)
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--capacity", type=int, default=1_000_000)
@@ -93,14 +137,20 @@ def main() -> None:
                     choices=["blocked", "flat"])
     args = ap.parse_args()
 
-    r = bench_fused_step(args.batch_size, args.seconds, args.capacity,
-                         args.num_banks, args.layout)
+    if args.mode == "e2e":
+        r = bench_e2e(args.batch_size, args.seconds, args.capacity,
+                      args.num_banks)
+        metric = "e2e_pipeline_throughput"
+    else:
+        r = bench_fused_step(args.batch_size, args.seconds, args.capacity,
+                             args.num_banks, args.layout)
+        metric = "fused_sketch_step_throughput"
     n_chips = max(1, len(jax.devices()))
     # Compare against this run's fair share of the 8-chip north star.
     target_here = NORTH_STAR_EVENTS_PER_SEC * min(n_chips, TARGET_CHIPS) \
         / TARGET_CHIPS
     print(json.dumps({
-        "metric": "fused_sketch_step_throughput",
+        "metric": metric,
         "value": round(r["events_per_sec"], 1),
         "unit": "events/sec",
         "vs_baseline": round(r["events_per_sec"] / target_here, 4),

@@ -1,0 +1,117 @@
+"""Fused pipeline tests: columnar store, load generator, end-to-end run."""
+
+import numpy as np
+import pytest
+
+from attendance_tpu.config import Config
+from attendance_tpu.pipeline.analyzer import AttendanceAnalyzer
+from attendance_tpu.pipeline.events import decode_binary_batch
+from attendance_tpu.pipeline.fast_path import FusedPipeline
+from attendance_tpu.pipeline.loadgen import (
+    frame_from_columns, generate_frames, synth_columns)
+from attendance_tpu.storage.columnar_store import ColumnarEventStore
+from attendance_tpu.transport.memory_broker import MemoryBroker, MemoryClient
+
+
+def test_loadgen_frame_roundtrip():
+    rng = np.random.default_rng(0)
+    roster = np.arange(10_000, 11_000, dtype=np.uint32)
+    cols = synth_columns(rng, 500, roster, num_lectures=4)
+    decoded = decode_binary_batch(frame_from_columns(cols))
+    for name in ("student_id", "lecture_day", "micros", "is_valid",
+                 "event_type"):
+        np.testing.assert_array_equal(decoded[name], cols[name])
+
+
+def test_columnar_store_dedup_last_write_wins():
+    store = ColumnarEventStore()
+    base = {
+        "student_id": np.array([1, 2], np.uint32),
+        "lecture_day": np.array([20260101, 20260101], np.uint32),
+        "micros": np.array([10, 20], np.int64),
+        "is_valid": np.array([True, True]),
+        "event_type": np.array([0, 0], np.int8),
+    }
+    store.insert_columns(base)
+    replay = dict(base)
+    replay["is_valid"] = np.array([False, True])  # last write wins
+    store.insert_columns(replay)
+    df = store.to_dataframe()
+    assert len(df) == 2
+    assert df[df.student_id == 1].is_valid.item() is np.False_
+
+
+def test_columnar_store_save_load(tmp_path):
+    store = ColumnarEventStore()
+    rng = np.random.default_rng(1)
+    store.insert_columns(synth_columns(
+        rng, 300, np.arange(10_000, 10_100, dtype=np.uint32), 4))
+    p = tmp_path / "events.npz"
+    store.save(p)
+    restored = ColumnarEventStore()
+    restored.load(p)
+    assert restored.to_dataframe().equals(store.to_dataframe())
+
+
+def test_fused_pipeline_end_to_end():
+    """Bulk frames -> fused dispatch -> columnar store; validity must
+    match the loadgen ground truth (the reference's oracle, SURVEY.md §4)
+    and the HLL counts must track exact uniques."""
+    config = Config(bloom_filter_capacity=50_000,
+                    transport_backend="memory")
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+
+    num_events, batch = 40_000, 4_096
+    roster, frames = generate_frames(num_events, batch,
+                                     roster_size=20_000, num_lectures=8,
+                                     invalid_fraction=0.2, seed=3)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=num_events, idle_timeout_s=0.5)
+
+    assert pipe.metrics.events == num_events
+    assert pipe.consumer.backlog() == 0  # everything acked post-commit
+
+    df = pipe.store.to_dataframe(deduplicate=False)
+    assert len(df) == num_events
+    truth = df  # loadgen is_valid was overwritten by computed validity…
+    # …so recompute ground truth from the id ranges: roster ids are the
+    # valid population, >=100000 ids are the invalid one.
+    in_roster = np.isin(df.student_id.to_numpy(np.uint32), roster)
+    stored_valid = df.is_valid.to_numpy(bool)
+    # no false negatives ever
+    assert stored_valid[in_roster].all()
+    # false positives bounded (eps=0.01 at far-below-capacity fill)
+    fp = stored_valid[~in_roster].mean() if (~in_roster).any() else 0.0
+    assert fp <= 0.02, fp
+
+    # HLL counts vs exact uniques per lecture (valid events only)
+    vdf = df[stored_valid]
+    for day, group in vdf.groupby("lecture_day"):
+        exact = group.student_id.nunique()
+        est = pipe.count(int(day))
+        assert est == pytest.approx(exact, rel=0.05, abs=3)
+
+
+def test_fused_pipeline_bad_frame_nacked():
+    config = Config(transport_backend="memory")
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    producer = client.create_producer(config.pulsar_topic)
+    producer.send(b"garbage-not-a-frame")
+    pipe.run(idle_timeout_s=0.3)
+    assert pipe.metrics.nacked_batches >= 1
+    assert pipe.metrics.events == 0
+
+
+def test_analyzer_reads_columnar_store():
+    store = ColumnarEventStore()
+    rng = np.random.default_rng(2)
+    store.insert_columns(synth_columns(
+        rng, 1_000, np.arange(10_000, 10_200, dtype=np.uint32), 4))
+    insights = AttendanceAnalyzer(store).generate_insights()
+    assert [i["title"] for i in insights][0] == "Habitual Latecomers"
+    assert insights[2]["data"]["most_attended"]
