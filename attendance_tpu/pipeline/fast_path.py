@@ -36,7 +36,7 @@ from attendance_tpu.models.hll import (
 from attendance_tpu.pipeline.events import decode_binary_batch
 from attendance_tpu.pipeline.processor import ProcessorMetrics
 from attendance_tpu.storage.columnar_store import ColumnarEventStore
-from attendance_tpu.transport import make_client
+from attendance_tpu.transport import handle_poison, make_client
 from attendance_tpu.transport.memory_broker import ReceiveTimeout
 
 logger = logging.getLogger(__name__)
@@ -58,8 +58,10 @@ class FusedPipeline:
         self.state, self.params = init_state(
             capacity=self.config.bloom_filter_capacity,
             error_rate=self.config.bloom_filter_error_rate,
-            layout=self.config.bloom_layout
-            if self.config.bloom_layout == "blocked" else "blocked",
+            # The fused packed step requires the blocked layout (its
+            # gather/AND kernel works on 512-bit blocks); a "flat" request
+            # is honored by the generic TpuSketchStore path, not here.
+            layout="blocked",
             num_banks=num_banks,
             precision=self.config.hll_precision)
         self._step = make_jitted_step_packed(self.params,
@@ -170,18 +172,27 @@ class FusedPipeline:
         self.metrics.device_seconds += time.perf_counter() - t0
         return valid_n
 
-    def _drain_inflight(self, force: bool) -> None:
+    def _drain_inflight(self, block: int = 0) -> None:
+        """Ack completed in-flight frames in dispatch order.
+
+        ``block`` is how many not-yet-ready head entries to wait for
+        (-1 = all).  On depth overflow the hot loop passes 1 — freeing
+        exactly one slot instead of collapsing the whole host/device
+        overlap with a full pipeline sync.
+        """
         while self._inflight:
             msg, valid = self._inflight[0]
-            if valid is not None and not force:
+            if valid is not None:
                 try:
                     ready = valid.is_ready()
                 except AttributeError:  # non-jax array (empty frame)
                     ready = True
                 if not ready:
-                    break
-            if valid is not None:
-                jax.block_until_ready(valid)
+                    if block == 0:
+                        break
+                    jax.block_until_ready(valid)
+                    if block > 0:
+                        block -= 1
             self.consumer.acknowledge(msg)
             self._inflight.popleft()
 
@@ -193,7 +204,7 @@ class FusedPipeline:
             try:
                 msg = self.consumer.receive(timeout_millis=50)
             except ReceiveTimeout:
-                self._drain_inflight(force=True)
+                self._drain_inflight(block=-1)
                 if time.monotonic() - idle_since > idle_timeout_s:
                     break
                 continue
@@ -201,16 +212,19 @@ class FusedPipeline:
             try:
                 valid = self.process_frame(msg.data())
             except Exception:
-                logger.exception("Bad frame; nacking")
-                self.metrics.nacked_batches += 1
-                self.consumer.negative_acknowledge(msg)
+                # Bounded retry, then dead-letter: an undecodable frame
+                # nacked forever livelocks the subscription (the broker
+                # redelivers immediately and receive() never times out).
+                logger.exception("Bad frame")
+                handle_poison(msg, self.consumer, self.metrics,
+                              self.config, logger)
                 continue
             self._inflight.append((msg, valid))
-            self._drain_inflight(force=len(self._inflight)
-                                 >= _INFLIGHT_DEPTH)
+            self._drain_inflight(
+                block=1 if len(self._inflight) >= _INFLIGHT_DEPTH else 0)
             if max_events is not None and self.metrics.events >= max_events:
                 break
-        self._drain_inflight(force=True)
+        self._drain_inflight(block=-1)
         self.metrics.wall_seconds = time.perf_counter() - t_start
 
     # -- queries ------------------------------------------------------------

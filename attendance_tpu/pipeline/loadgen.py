@@ -23,13 +23,23 @@ _BASE_MICROS = 1_753_000_000_000_000  # an arbitrary 2025 epoch anchor
 
 def synth_columns(rng: np.random.Generator, batch: int,
                   roster: np.ndarray, num_lectures: int,
-                  invalid_fraction: float = 0.1) -> dict:
-    """One micro-batch of synthetic swipe columns."""
+                  invalid_fraction: float = 0.1,
+                  invalid_base: Optional[int] = None) -> dict:
+    """One micro-batch of synthetic swipe columns.
+
+    Invalid ids are drawn strictly above the roster's id range so the
+    ground-truth ``is_valid`` column never mislabels an event (the
+    reference keeps the populations disjoint the same way: valid ids
+    10000-99999, invalid 100000-999999, data_generator.py:53-54,80-81).
+    """
+    if invalid_base is None:
+        invalid_base = max(100_000, int(roster.max()) + 1)
     valid = rng.random(batch) >= invalid_fraction
     student = np.where(
         valid,
         roster[rng.integers(0, len(roster), batch)],
-        rng.integers(100_000, 1_000_000, batch).astype(np.uint32))
+        rng.integers(invalid_base, invalid_base + 900_000,
+                     batch).astype(np.uint32))
     day = (20_260_701 + rng.integers(0, num_lectures, batch)).astype(
         np.uint32)
     micros = (_BASE_MICROS
@@ -71,13 +81,15 @@ def generate_frames(num_events: int, batch: int,
     roster = rng.choice(np.arange(10_000, 10_000 + 4 * roster_size,
                                   dtype=np.uint32),
                         size=roster_size, replace=False)
+    invalid_base = max(100_000, 10_000 + 4 * roster_size)
 
     def frames():
         left = num_events
         while left > 0:
             n = min(batch, left)
             yield frame_from_columns(synth_columns(
-                rng, n, roster, num_lectures, invalid_fraction))
+                rng, n, roster, num_lectures, invalid_fraction,
+                invalid_base=invalid_base))
             left -= n
 
     return roster, frames()

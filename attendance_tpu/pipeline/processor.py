@@ -35,7 +35,7 @@ from attendance_tpu.sketch import make_sketch_store
 from attendance_tpu.sketch.base import ResponseError
 from attendance_tpu.storage import make_event_store
 from attendance_tpu.storage.memory_store import AttendanceRow
-from attendance_tpu.transport import make_client
+from attendance_tpu.transport import handle_poison, make_client
 from attendance_tpu.transport.memory_broker import ReceiveTimeout
 
 logger = logging.getLogger(__name__)
@@ -196,15 +196,9 @@ class AttendanceProcessor:
                         events.append(decode_event(m.data()))
                         good_msgs.append(m)
                     except Exception:
-                        if (m.redelivery_count
-                                >= self.config.max_redeliveries):
-                            logger.error("Dead-lettering undecodable frame "
-                                         "after %d redeliveries",
-                                         m.redelivery_count)
-                            self.metrics.dead_lettered += 1
-                            self.consumer.acknowledge(m)
-                        else:
-                            self.consumer.negative_acknowledge(m)
+                        handle_poison(m, self.consumer, self.metrics,
+                                      self.config, logger,
+                                      count_nack=False)
                 try:
                     self.process_events(events)
                     consecutive_failures = 0

@@ -19,6 +19,39 @@ from attendance_tpu.transport.memory_broker import (  # noqa: F401
     MemoryBroker, MemoryClient, ReceiveTimeout)
 
 
+def redelivery_count(msg) -> int:
+    """Delivery-attempt count of a received message, backend-agnostic.
+
+    The memory broker exposes ``redelivery_count`` as an attribute; the
+    real pulsar-client exposes it as a method on ``pulsar.Message``.
+    """
+    rc = msg.redelivery_count
+    return rc() if callable(rc) else rc
+
+
+def handle_poison(msg, consumer, metrics, config, logger, *,
+                  count_nack: bool = True) -> None:
+    """Bounded-retry poison-message policy shared by both processors.
+
+    Nack for broker redelivery up to ``config.max_redeliveries`` attempts,
+    then dead-letter (ack + count) so one undecodable frame cannot
+    livelock the subscription. The reference nacks forever (reference
+    attendance_processor.py:134-136, no DLQ despite its README).
+    ``count_nack=False`` skips the nacked_batches counter for callers
+    whose unit of nacking is a message, not a batch.
+    """
+    attempts = redelivery_count(msg)
+    if attempts >= config.max_redeliveries:
+        logger.error("Dead-lettering poison frame after %d redeliveries",
+                     attempts)
+        metrics.dead_lettered += 1
+        consumer.acknowledge(msg)
+    else:
+        if count_nack:
+            metrics.nacked_batches += 1
+        consumer.negative_acknowledge(msg)
+
+
 def make_client(config):
     """Build the transport client selected by config.transport_backend."""
     if config.transport_backend == "memory":

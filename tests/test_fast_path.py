@@ -38,7 +38,8 @@ def test_columnar_store_dedup_last_write_wins():
     store.insert_columns(replay)
     df = store.to_dataframe()
     assert len(df) == 2
-    assert df[df.student_id == 1].is_valid.item() is np.False_
+    assert not df[df.student_id == 1].is_valid.item()
+    assert df[df.student_id == 2].is_valid.item()
 
 
 def test_columnar_store_save_load(tmp_path):
@@ -96,15 +97,41 @@ def test_fused_pipeline_end_to_end():
         assert est == pytest.approx(exact, rel=0.05, abs=3)
 
 
-def test_fused_pipeline_bad_frame_nacked():
-    config = Config(transport_backend="memory")
+def test_fused_pipeline_bad_frame_dead_lettered():
+    """A poison frame is retried max_redeliveries times, then
+    dead-lettered (acked + counted) so the loop terminates instead of
+    livelocking on instant broker redelivery."""
+    config = Config(transport_backend="memory", max_redeliveries=3)
     client = MemoryClient(MemoryBroker())
     pipe = FusedPipeline(config, client=client, num_banks=8)
     producer = client.create_producer(config.pulsar_topic)
     producer.send(b"garbage-not-a-frame")
     pipe.run(idle_timeout_s=0.3)
-    assert pipe.metrics.nacked_batches >= 1
+    assert pipe.metrics.nacked_batches == config.max_redeliveries
+    assert pipe.metrics.dead_lettered == 1
     assert pipe.metrics.events == 0
+    assert pipe.consumer.backlog() == 0  # poison frame removed from sub
+
+
+def test_fused_pipeline_bad_frame_does_not_poison_good_ones():
+    """Good frames interleaved with a poison frame all process."""
+    config = Config(bloom_filter_capacity=10_000,
+                    transport_backend="memory", max_redeliveries=2)
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    roster, frames = generate_frames(2_000, 500, roster_size=1_000,
+                                     num_lectures=2, seed=7)
+    frames = list(frames)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    producer.send(frames[0])
+    producer.send(b"\x00bad")
+    for f in frames[1:]:
+        producer.send(f)
+    pipe.run(idle_timeout_s=0.3)
+    assert pipe.metrics.events == 2_000
+    assert pipe.metrics.dead_lettered == 1
+    assert pipe.consumer.backlog() == 0
 
 
 def test_analyzer_reads_columnar_store():
