@@ -78,6 +78,10 @@ class Config:
     num_shards: int = 1
     num_replicas: int = 1
     # Snapshot directory for sketch checkpoint/restore ("" = disabled).
+    # When set, processors restore on start and snapshot at ack barriers
+    # every snapshot_every_batches batches (<= 0 = a default cadence of
+    # 64 — a set dir always checkpoints, because restoring stale state
+    # while acking would lose events).
     snapshot_dir: str = ""
     snapshot_every_batches: int = 0
     # Poison-message handling: a frame that fails decode/processing is

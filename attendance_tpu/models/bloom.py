@@ -163,6 +163,123 @@ def bloom_fill_fraction(bits: jax.Array) -> jax.Array:
     return jnp.mean(bits.astype(jnp.float32))
 
 
+# ---------------------------------------------------------------------------
+# Bit-packed representation: uint32[m_bits // 32]
+#
+# The byte-per-bit arrays above are simple and scatter-friendly but cost
+# 8x the HBM a Bloom filter needs — a 10M-student roster at eps=0.01 is
+# ~96MB of bytes vs ~12MB of real bits. The packed representation stores
+# 32 filter bits per uint32 word; probe positions are IDENTICAL
+# (bloom_positions is shared), so packed and byte filters answer
+# bit-identically, and the memory story scales to the 10M-roster sharded
+# configuration (BASELINE.md bench config #4).
+#
+#   query:  gather word pos>>5, test bit pos&31 — same gather count as the
+#           byte path, 1/8th the resident state.
+#   update: XLA has no bitwise-OR scatter, so duplicate word indices
+#           inside a batch can't be combined by the scatter itself.
+#           bloom_add_packed therefore sorts the batch's probe words,
+#           OR-combines runs of equal words with a segmented scan, and
+#           scatters each run's total through its last element only —
+#           unique indices, deterministic, and still idempotent under
+#           replay (OR of already-set bits). O(N log N) in the batch, not
+#           O(m): no dense temporary is ever materialized.
+# ---------------------------------------------------------------------------
+
+def bloom_packed_init(params: BloomParams) -> jax.Array:
+    """Fresh all-zero packed filter: uint32[m_bits // 32]."""
+    assert params.m_bits % 32 == 0  # m_bits is always a 512-bit multiple
+    return jnp.zeros((params.m_bits // 32,), dtype=jnp.uint32)
+
+
+def pack_bloom_bits(bits: jax.Array) -> jax.Array:
+    """uint8[m_bits] (byte per bit) -> packed uint32[m_bits // 32].
+
+    Bit ``pos`` of the filter lives at word ``pos >> 5``, bit
+    ``pos & 31`` — the layout bloom_contains_words probes.
+    """
+    m_bits = bits.shape[0]
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(bits.reshape(m_bits // 32, 32).astype(jnp.uint32)
+                   * weights[None, :], axis=1)
+
+
+def unpack_bloom_bits(words: jax.Array) -> jax.Array:
+    """Packed uint32[m_words] -> uint8[m_words * 32] (byte per bit)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[:, None] >> shifts[None, :]) & jnp.uint32(1)
+    return bits.astype(jnp.uint8).reshape(-1)
+
+
+def packed_or_scatter(words: jax.Array, pos: jax.Array,
+                      m_words: int) -> jax.Array:
+    """OR the bits at flat positions ``pos`` into packed ``words``.
+
+    pos: int32[N] bit positions; positions >= m_words*32 are dropped
+    (the sentinel callers use for masked/out-of-slice lanes).
+
+    XLA has no bitwise-OR scatter, so duplicate word indices inside a
+    batch can't be combined by the scatter itself. Instead: sort the
+    probe words, OR-combine runs of equal words with a segmented scan,
+    and scatter each run's total through its last element only — unique
+    indices, deterministic, idempotent under replay, O(N log N) in the
+    batch with no dense temporary.
+    """
+    w = jnp.minimum(pos >> 5, m_words)  # sentinel -> m_words (OOB)
+    bit = (pos & 31).astype(jnp.uint32)
+    m = jnp.where(w < m_words, jnp.uint32(1) << bit, jnp.uint32(0))
+    order = jnp.argsort(w)
+    ws = w[order]
+    ms = m[order]
+    # Segmented inclusive OR-scan: the last element of each equal-word
+    # run ends holding the full run OR.
+    starts = jnp.concatenate([jnp.array([True]), ws[1:] != ws[:-1]])
+
+    def seg_or(a, b):
+        a_s, a_v = a
+        b_s, b_v = b
+        return a_s | b_s, jnp.where(b_s, b_v, a_v | b_v)
+
+    _, run_or = jax.lax.associative_scan(seg_or, (starts, ms))
+    last = jnp.concatenate([ws[:-1] != ws[1:], jnp.array([True])])
+    scatter_idx = jnp.where(last, ws, m_words)  # non-last lanes dropped
+    safe_idx = jnp.clip(scatter_idx, 0, m_words - 1)
+    merged = words[safe_idx] | run_or
+    return words.at[scatter_idx].set(merged, mode="drop")
+
+
+def bloom_add_packed(words: jax.Array, keys: jax.Array, params: BloomParams,
+                     mask: Optional[jax.Array] = None) -> jax.Array:
+    """Insert a batch of keys into a packed filter; returns new words.
+
+    Masked lanes take a sentinel position one past the end and are
+    dropped by the scatter (see packed_or_scatter).
+    """
+    m_words = params.m_bits // 32
+    pos = bloom_positions(keys, params).astype(jnp.int32)
+    if mask is not None:
+        pos = jnp.where(mask[:, None], pos, params.m_bits)
+    return packed_or_scatter(words, pos.reshape(-1), m_words)
+
+
+def bloom_contains_words(words: jax.Array, keys: jax.Array,
+                         params: BloomParams) -> jax.Array:
+    """Membership test against a packed filter: bool[B].
+
+    Bit-identical to bloom_contains over the byte representation (same
+    bloom_positions), at 1/8th the resident HBM.
+    """
+    pos = bloom_positions(keys, params).astype(jnp.int32)
+    probes = words[pos >> 5]                       # gather: [B, k] uint32
+    bit = (pos & 31).astype(jnp.uint32)
+    return jnp.all((probes >> bit) & jnp.uint32(1) == jnp.uint32(1), axis=1)
+
+
+def bloom_packed_fill_fraction(words: jax.Array) -> jax.Array:
+    """Fraction of set bits of a packed filter (device scalar)."""
+    return jnp.mean(unpack_bloom_bits(words).astype(jnp.float32))
+
+
 class BloomFilter:
     """Object shell over the functional kernels, holding device state.
 

@@ -16,13 +16,20 @@ import jax
 import jax.numpy as jnp
 
 from attendance_tpu.models.bloom import (
-    BloomParams, bloom_contains, bloom_init, derive_bloom_params)
+    BloomParams, bloom_contains_words, bloom_packed_init,
+    derive_bloom_params)
 from attendance_tpu.models.hll import hll_add, hll_init
 
 
 class SketchState(NamedTuple):
-    """Device-resident state threaded through the fused step."""
-    bloom_bits: jax.Array  # uint8[m_bits]
+    """Device-resident state threaded through the fused step.
+
+    The Bloom filter is bit-packed (uint32 words, 32 filter bits each) so
+    a 10M-student roster costs ~12MB of HBM, not the ~96MB a byte-per-bit
+    array would — the memory budget that makes sketch sharding worthwhile
+    at BASELINE.md bench config #4 scale.
+    """
+    bloom_bits: jax.Array  # uint32[m_bits // 32], bit-packed
     hll_regs: jax.Array    # uint8[num_banks, 2^p]
 
 
@@ -30,7 +37,7 @@ def init_state(capacity: int = 100_000, error_rate: float = 0.01,
                layout: str = "blocked", num_banks: int = 64,
                precision: int = 14) -> Tuple[SketchState, BloomParams]:
     params = derive_bloom_params(capacity, error_rate, layout)
-    return SketchState(bloom_init(params),
+    return SketchState(bloom_packed_init(params),
                        hll_init(num_banks, precision)), params
 
 
@@ -48,7 +55,7 @@ def fused_step(state: SketchState, keys: jax.Array, bank_idx: jax.Array,
     (reference semantics: PFADD iff BF.EXISTS,
     attendance_processor.py:127-129).
     """
-    valid = bloom_contains(state.bloom_bits, keys, params)
+    valid = bloom_contains_words(state.bloom_bits, keys, params)
     regs = hll_add(state.hll_regs,
                    jnp.where(valid & mask, bank_idx, -1),
                    keys, precision=precision)
@@ -74,7 +81,7 @@ def fused_step_packed(state: SketchState, packed: jax.Array,
     HLL scatter already drops."""
     keys = packed[0]
     bank_idx = packed[1].astype(jnp.int32)
-    valid = bloom_contains(state.bloom_bits, keys, params)
+    valid = bloom_contains_words(state.bloom_bits, keys, params)
     regs = hll_add(state.hll_regs,
                    jnp.where(valid, bank_idx, -1),
                    keys, precision=precision)

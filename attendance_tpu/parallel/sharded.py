@@ -1,7 +1,8 @@
 """Sharded sketch engine: shard_map kernels over a (dp, sp) device mesh.
 
 State layout (per SURVEY.md §2.3 "hash-prefix sharding"):
-  * Bloom bit array  uint8[m_bits]        — axis 0 split across "sp",
+  * Bloom bit array  uint32[m_bits/32]    — bit-packed words, axis 0
+                                            split across "sp",
                                             replicated across "dp".
   * HLL banks        uint8[banks, m_regs] — register axis split across
                                             "sp", replicated across "dp".
@@ -37,7 +38,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from attendance_tpu.models.bloom import (
-    BLOCK_BITS, BloomParams, bloom_positions, derive_bloom_params)
+    BLOCK_BITS, BloomParams, bloom_positions, derive_bloom_params,
+    packed_or_scatter)
 from attendance_tpu.models.hll import (
     estimate_from_histogram, hll_bucket_rank)
 
@@ -79,9 +81,12 @@ class ShardedSketchEngine:
         # whole blocks, but the hash modulus stays params.m_bits — so a
         # key's probe positions (and therefore every validity bit) are
         # identical on every mesh shape; the pad blocks are simply never
-        # addressed.
+        # addressed. Storage is bit-packed (uint32 words): per-shard HBM
+        # is m_alloc / 8 / sp bytes, 1/8th of a byte-per-bit layout —
+        # what keeps a 10M-student roster at ~14MB total.
         chunk = self.sp * BLOCK_BITS
         self.m_alloc = ((self.params.m_bits + chunk - 1) // chunk) * chunk
+        self.m_words = self.m_alloc // 32
         self.m_regs = 1 << precision
         if self.m_regs % self.sp:
             raise ValueError(f"sp={self.sp} must divide {self.m_regs}")
@@ -90,7 +95,7 @@ class ShardedSketchEngine:
         bits_sharding = NamedSharding(mesh, P("sp"))
         regs_sharding = NamedSharding(mesh, P(None, "sp"))
         self.bits = jax.device_put(
-            jnp.zeros((self.m_alloc,), jnp.uint8), bits_sharding)
+            jnp.zeros((self.m_words,), jnp.uint32), bits_sharding)
         self.regs = jax.device_put(
             jnp.zeros((num_banks, self.m_regs), jnp.uint8), regs_sharding)
         self._build_kernels()
@@ -100,32 +105,44 @@ class ShardedSketchEngine:
         mesh = self.mesh
         params = self.params
         precision = self.precision
-        m_local = self.m_alloc // self.sp
+        dp = self.dp
+        m_words_local = self.m_words // self.sp
+        m_local = m_words_local * 32  # filter bits per sp slice
         regs_local = self.m_regs // self.sp
 
-        def local_contains(bits_loc, keys):
+        def local_contains(words_loc, keys):
             """Per-device partial membership: AND over the probes whose
             global position falls in this device's slice (True elsewhere:
-            the AND-identity)."""
+            the AND-identity). Probes gather packed uint32 words and test
+            the bit in-register."""
             pos = bloom_positions(keys, params).astype(jnp.int32)
             lo = jax.lax.axis_index("sp").astype(jnp.int32) * m_local
             rel = pos - lo
             in_range = (rel >= 0) & (rel < m_local)
+            word = words_loc[jnp.clip(rel >> 5, 0, m_words_local - 1)]
+            bit = (jnp.clip(rel, 0, m_local - 1) & 31).astype(jnp.uint32)
             probes = jnp.where(
-                in_range, bits_loc[jnp.clip(rel, 0, m_local - 1)],
-                jnp.uint8(1))
-            return jnp.all(probes == jnp.uint8(1), axis=1)
+                in_range, (word >> bit) & jnp.uint32(1), jnp.uint32(1))
+            return jnp.all(probes == jnp.uint32(1), axis=1)
 
-        def bloom_add_kernel(bits_loc, keys, mask):
+        def bloom_add_kernel(words_loc, keys, mask):
             pos = bloom_positions(keys, params).astype(jnp.int32)
             lo = jax.lax.axis_index("sp").astype(jnp.int32) * m_local
             rel = pos - lo
             keep = (rel >= 0) & (rel < m_local) & mask[:, None]
             rel = jnp.where(keep, rel, m_local)  # OOB -> dropped
-            bits_loc = bits_loc.at[rel.reshape(-1)].set(
-                jnp.uint8(1), mode="drop")
-            # OR-allreduce across replicas (bytes are {0,1} so max == or).
-            return jax.lax.pmax(bits_loc, "dp")
+            words_loc = packed_or_scatter(words_loc, rel.reshape(-1),
+                                          m_words_local)
+            # OR-allreduce across replicas. pmax is wrong for packed
+            # words (max of two words is not their bit union), so gather
+            # the dp copies and OR them locally — preload-only traffic.
+            if dp > 1:
+                gathered = jax.lax.all_gather(words_loc, "dp")
+                out = gathered[0]
+                for r in range(1, dp):
+                    out = out | gathered[r]
+                words_loc = out
+            return words_loc
 
         def hll_add_local(regs_loc, bank_idx, keys, mask):
             bucket, rank = hll_bucket_rank(keys, precision)
@@ -163,10 +180,13 @@ class ShardedSketchEngine:
             return jax.lax.psum(hist, "sp")
 
         smap = functools.partial(jax.shard_map, mesh=mesh)
-        self._preload = jax.jit(smap(
-            bloom_add_kernel,
+        # check_vma=False: the all_gather+OR leaves every dp replica with
+        # the identical union filter, but the static varying-axes checker
+        # cannot infer that replication through the elementwise ORs.
+        self._preload = jax.jit(jax.shard_map(
+            bloom_add_kernel, mesh=mesh,
             in_specs=(P("sp"), P("dp"), P("dp")),
-            out_specs=P("sp")),
+            out_specs=P("sp"), check_vma=False),
             donate_argnums=(0,))
         self._step = jax.jit(smap(
             step_kernel,
@@ -203,8 +223,13 @@ class ShardedSketchEngine:
         self.bits = self._preload(self.bits, jnp.asarray(kbuf),
                                   jnp.asarray(mask))
 
-    def step(self, keys, bank_idx) -> np.ndarray:
-        """Fused validate+count for one micro-batch; returns validity[B]."""
+    def step(self, keys, bank_idx) -> jax.Array:
+        """Fused validate+count for one micro-batch; returns validity[B].
+
+        The result is the (async) device array — callers that need host
+        values use np.asarray / block_until_ready, and the pipelined
+        consumer keeps its host/device overlap instead of syncing here.
+        """
         keys = np.asarray(keys, dtype=np.uint32)
         bank_idx = np.asarray(bank_idx, dtype=np.int32)
         kbuf, n = self._pad(keys, 0, np.uint32)
@@ -214,12 +239,49 @@ class ShardedSketchEngine:
         valid, self.regs = self._step(self.bits, self.regs,
                                       jnp.asarray(kbuf), jnp.asarray(bbuf),
                                       jnp.asarray(mask))
-        return np.asarray(valid)[:n]
+        return valid[:n]
 
     def contains(self, keys) -> np.ndarray:
         keys = np.asarray(keys, dtype=np.uint32)
         kbuf, n = self._pad(keys, 0, np.uint32)
         return np.asarray(self._query(self.bits, jnp.asarray(kbuf)))[:n]
+
+    def grow_banks(self, new_num_banks: int) -> None:
+        """Double-style bank growth (rare; one host round-trip + reshard)."""
+        regs_host = np.asarray(self.regs)
+        grown = np.zeros((new_num_banks, self.m_regs), np.uint8)
+        grown[:regs_host.shape[0]] = regs_host
+        self.num_banks = new_num_banks
+        self.regs = jax.device_put(
+            jnp.asarray(grown), NamedSharding(self.mesh, P(None, "sp")))
+
+    def get_state(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Host copies of (packed bloom words, HLL register banks).
+
+        The bloom words are returned UNPADDED (m_bits // 32 words): the
+        sp-dependent allocation padding is never addressed and always
+        zero, so snapshots restore across different mesh shapes (and
+        to/from the single-chip pipeline).
+        """
+        real_words = self.params.m_bits // 32
+        return np.asarray(self.bits)[:real_words], np.asarray(self.regs)
+
+    def set_state(self, bits: np.ndarray, regs: np.ndarray) -> None:
+        """Restore state captured by get_state (or by the single-chip
+        pipeline) onto this mesh — state is global; only the allocation
+        padding differs per mesh shape and is re-zeroed here."""
+        real_words = self.params.m_bits // 32
+        if bits.shape != (real_words,):
+            raise ValueError(
+                f"snapshot bloom has {bits.shape[0]} words, engine "
+                f"expects {real_words} (different capacity/layout?)")
+        padded = np.zeros(self.m_words, dtype=np.uint32)
+        padded[:real_words] = bits
+        self.num_banks = regs.shape[0]
+        self.bits = jax.device_put(
+            jnp.asarray(padded), NamedSharding(self.mesh, P("sp")))
+        self.regs = jax.device_put(
+            jnp.asarray(regs), NamedSharding(self.mesh, P(None, "sp")))
 
     def count(self, bank: int) -> int:
         """PFCOUNT of one bank (Ertl estimator over the psum'd histogram)."""

@@ -8,28 +8,52 @@ attendance_processor.py:100-136) at the other end of the batching
 spectrum from AttendanceProcessor (which keeps the JSON wire format and
 the generic SketchStore API).
 
+Execution backends, selected by config:
+  * single chip (num_shards * num_replicas == 1): bit-packed Bloom words
+    + HLL banks resident on one device, one fused jitted dispatch per
+    frame with a combined [2, B] input transfer.
+  * sharded (product > 1): the same sketches partitioned over a
+    (dp, sp) jax.sharding.Mesh via parallel.ShardedSketchEngine —
+    hash-range Bloom/HLL shards, AND-across-shards queries, register-max
+    replica sync; the multi-chip scale-out the reference gets from
+    Pulsar Shared-subscription competing consumers
+    (attendance_processor.py:30-34) plus a sketch capacity no single
+    Redis node would hold (BASELINE.md bench config #4).
+
 Ack ordering under pipelining (SURVEY.md §7 hard part f): dispatches are
 enqueued asynchronously so host decode of batch N+1 overlaps device
 execution of batch N, but a frame is acknowledged only after its batch's
 device outputs are materialized — an in-flight deque of (frame, outputs)
 drains as results become ready, preserving the reference's
 ack-after-commit at-least-once contract (attendance_processor.py:132).
-Replays after a crash are harmless: scatter-set/scatter-max sketches and
+Replays after a crash are harmless: scatter-OR/scatter-max sketches and
 the read-time-dedup columnar store are all idempotent (SURVEY.md §5).
+
+Checkpoint/resume (SURVEY.md §5): when config.snapshot_dir is set, the
+pipeline restores sketch + store state on construction and snapshots
+every config.snapshot_every_batches frames. Snapshots are ack BARRIERS:
+a frame is acknowledged only at the first checkpoint after its outputs
+commit, so every acknowledged event is durably in a snapshot — a crash
+loses nothing (unacked frames redeliver; replay into idempotent sinks is
+free). This replaces the reference's reliance on external-service
+durability (Redis RDB / Cassandra sstables / Pulsar cursor,
+attendance_processor.py:56-72,90-92 re-entrancy).
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import time
 from collections import deque
+from pathlib import Path
 from typing import Dict, Optional
 
 import jax
 import numpy as np
 
 from attendance_tpu.config import Config
-from attendance_tpu.models.bloom import bloom_add
+from attendance_tpu.models.bloom import bloom_add_packed
 from attendance_tpu.models.fused import init_state, make_jitted_step_packed
 from attendance_tpu.models.hll import (
     best_histogram, estimate_from_histogram)
@@ -42,6 +66,10 @@ from attendance_tpu.transport.memory_broker import ReceiveTimeout
 logger = logging.getLogger(__name__)
 
 _INFLIGHT_DEPTH = 8  # dispatched-but-unacked batches before forcing a sync
+DEFAULT_SNAPSHOT_EVERY = 64  # barrier cadence when only snapshot_dir is set
+
+SKETCH_SNAPSHOT = "fused_sketch.npz"
+EVENTS_SNAPSHOT = "fused_events.npz"
 
 
 class FusedPipeline:
@@ -49,26 +77,43 @@ class FusedPipeline:
 
     def __init__(self, config: Optional[Config] = None, *,
                  client=None, store: Optional[ColumnarEventStore] = None,
-                 num_banks: int = 256):
+                 num_banks: int = 256, mesh=None):
         self.config = config or Config()
         self.client = client or make_client(self.config)
         self.consumer = self.client.subscribe(
             self.config.pulsar_topic, self.SUBSCRIPTION)
         self.store = store or ColumnarEventStore()
-        self.state, self.params = init_state(
-            capacity=self.config.bloom_filter_capacity,
-            error_rate=self.config.bloom_filter_error_rate,
-            # The fused packed step requires the blocked layout (its
-            # gather/AND kernel works on 512-bit blocks); a "flat" request
-            # is honored by the generic TpuSketchStore path, not here.
-            layout="blocked",
-            num_banks=num_banks,
-            precision=self.config.hll_precision)
-        self._step = make_jitted_step_packed(self.params,
-                                             self.config.hll_precision)
-        self._preload = jax.jit(
-            lambda bits, keys: bloom_add(bits, keys, self.params),
-            donate_argnums=(0,))
+        self.sharded = (self.config.num_shards
+                        * self.config.num_replicas) > 1
+        if self.sharded:
+            from attendance_tpu.parallel.sharded import (
+                ShardedSketchEngine, make_mesh)
+            self.engine = ShardedSketchEngine(
+                mesh or make_mesh(self.config.num_shards,
+                                  self.config.num_replicas),
+                capacity=self.config.bloom_filter_capacity,
+                error_rate=self.config.bloom_filter_error_rate,
+                num_banks=num_banks,
+                precision=self.config.hll_precision,
+                layout="blocked")
+            self.params = self.engine.params
+        else:
+            self.engine = None
+            self.state, self.params = init_state(
+                capacity=self.config.bloom_filter_capacity,
+                error_rate=self.config.bloom_filter_error_rate,
+                # The fused packed step requires the blocked layout (one
+                # 512-bit block per key); a "flat" request is honored by
+                # the generic TpuSketchStore path, not here.
+                layout="blocked",
+                num_banks=num_banks,
+                precision=self.config.hll_precision)
+            self._step = make_jitted_step_packed(
+                self.params, self.config.hll_precision)
+            self._preload = jax.jit(
+                lambda bits, keys: bloom_add_packed(bits, keys,
+                                                    self.params),
+                donate_argnums=(0,))
         self._bank_of: Dict[int, int] = {}
         # Dense day->bank lookup: maps days in [base, base + LUT) with one
         # O(n) fancy-index instead of an O(n log n) np.unique per batch.
@@ -76,28 +121,54 @@ class FusedPipeline:
         self._day_lut = np.full(self._LUT_SIZE, -1, np.int32)
         self.metrics = ProcessorMetrics()
         self._inflight = deque()
+        # Snapshot/checkpoint wiring (dir empty = disabled). A set dir
+        # with no interval still checkpoints (at a default cadence):
+        # restoring on start but never snapshotting again would lose
+        # every event acked after the restored snapshot on the next
+        # crash.
+        self._snap_dir = (Path(self.config.snapshot_dir)
+                          if self.config.snapshot_dir else None)
+        self._snap_every = (self.config.snapshot_every_batches
+                            if self.config.snapshot_every_batches > 0
+                            else DEFAULT_SNAPSHOT_EVERY)
+        self._batches_at_snap = 0
+        if self._snap_dir is not None:
+            self.restore()
 
     _LUT_SIZE = 1 << 14  # covers ~44 years of calendar days from base
 
     # -- roster -------------------------------------------------------------
     def preload(self, keys) -> None:
         keys = np.asarray(keys, dtype=np.uint32)
-        self.state = self.state._replace(bloom_bits=self._preload(
-            self.state.bloom_bits, jax.numpy.asarray(keys)))
+        if self.sharded:
+            self.engine.preload(keys)
+        else:
+            self.state = self.state._replace(bloom_bits=self._preload(
+                self.state.bloom_bits, jax.numpy.asarray(keys)))
 
     # -- bank mapping -------------------------------------------------------
-    def _register_day(self, day: int) -> int:
-        bank = self._bank_of.get(day)
-        if bank is not None:
-            return bank
-        bank = len(self._bank_of)
-        if bank >= self.state.hll_regs.shape[0]:
-            # Double the bank array (rare; one recompile per size).
+    def _num_banks(self) -> int:
+        return (self.engine.num_banks if self.sharded
+                else self.state.hll_regs.shape[0])
+
+    def _grow_banks(self) -> None:
+        if self.sharded:
+            self.engine.grow_banks(self.engine.num_banks * 2)
+        else:
             regs = self.state.hll_regs
             grown = jax.numpy.zeros(
                 (regs.shape[0] * 2, regs.shape[1]), regs.dtype)
             self.state = self.state._replace(
                 hll_regs=grown.at[:regs.shape[0]].set(regs))
+
+    def _register_day(self, day: int) -> int:
+        bank = self._bank_of.get(day)
+        if bank is not None:
+            return bank
+        bank = len(self._bank_of)
+        if bank >= self._num_banks():
+            # Double the bank array (rare; one recompile per size).
+            self._grow_banks()
         self._bank_of[day] = bank
         if self._day_base is not None:
             off = day - self._day_base
@@ -152,19 +223,22 @@ class FusedPipeline:
         n = len(cols["student_id"])
         if n == 0:
             return None
-        padded = 256
-        while padded < n:
-            padded *= 2
-        # ONE combined transfer: row 0 keys, row 1 bank ids (-1 pads).
-        packed = np.empty((2, padded), np.uint32)
-        packed[0, :n] = cols["student_id"]
-        packed[0, n:] = 0
-        packed[1, :n] = self._banks_for(
-            cols["lecture_day"]).view(np.uint32)
-        packed[1, n:] = np.uint32(0xFFFFFFFF)  # bank -1: dropped lanes
-        self.state, valid = self._step(self.state,
-                                       jax.numpy.asarray(packed))
-        valid_n = valid[:n]
+        banks = self._banks_for(cols["lecture_day"])
+        if self.sharded:
+            valid_n = self.engine.step(cols["student_id"], banks)
+        else:
+            padded = 256
+            while padded < n:
+                padded *= 2
+            # ONE combined transfer: row 0 keys, row 1 bank ids (-1 pads).
+            packed = np.empty((2, padded), np.uint32)
+            packed[0, :n] = cols["student_id"]
+            packed[0, n:] = 0
+            packed[1, :n] = banks.view(np.uint32)
+            packed[1, n:] = np.uint32(0xFFFFFFFF)  # bank -1: dropped lanes
+            self.state, valid = self._step(self.state,
+                                           jax.numpy.asarray(packed))
+            valid_n = valid[:n]
         self.store.insert_columns({**cols, "is_valid": valid_n})
         self.metrics.batches += 1
         self.metrics.events += n
@@ -172,14 +246,101 @@ class FusedPipeline:
         self.metrics.device_seconds += time.perf_counter() - t0
         return valid_n
 
+    # -- checkpointing ------------------------------------------------------
+    @property
+    def checkpointing(self) -> bool:
+        return self._snap_dir is not None
+
+    def snapshot(self) -> None:
+        """Write sketch + store state atomically to snapshot_dir."""
+        if self._snap_dir is None:
+            return
+        self._snap_dir.mkdir(parents=True, exist_ok=True)
+        if self.sharded:
+            bits, regs = self.engine.get_state()
+        else:
+            bits = np.asarray(self.state.bloom_bits)
+            regs = np.asarray(self.state.hll_regs)
+        manifest = {
+            "bank_of": {str(d): b for d, b in self._bank_of.items()},
+            "m_bits": self.params.m_bits,
+            "k": self.params.k,
+            "precision": self.config.hll_precision,
+            "events": self.metrics.events,
+        }
+        path = self._snap_dir / SKETCH_SNAPSHOT
+        tmp = path.with_suffix(".tmp")
+        with open(tmp, "wb") as f:
+            np.savez_compressed(
+                f, bloom_words=bits, hll_regs=regs,
+                manifest=np.frombuffer(
+                    json.dumps(manifest).encode(), dtype=np.uint8))
+        tmp.replace(path)
+        self.store.save(self._snap_dir / EVENTS_SNAPSHOT)
+        self._batches_at_snap = self.metrics.batches
+
+    def restore(self) -> bool:
+        """Load the latest snapshot from snapshot_dir, if one exists."""
+        if self._snap_dir is None:
+            return False
+        path = self._snap_dir / SKETCH_SNAPSHOT
+        if not path.exists():
+            return False
+        with np.load(path) as data:
+            manifest = json.loads(bytes(data["manifest"]).decode())
+            if manifest["m_bits"] != self.params.m_bits:
+                raise ValueError(
+                    f"snapshot filter is {manifest['m_bits']} bits but "
+                    f"config derives {self.params.m_bits} — capacity/"
+                    "error-rate/layout changed since the snapshot")
+            if manifest["precision"] != self.config.hll_precision:
+                raise ValueError(
+                    f"snapshot HLL precision is {manifest['precision']} "
+                    f"but config requests {self.config.hll_precision} — "
+                    "register banks are not convertible across precisions")
+            bits = data["bloom_words"]
+            regs = data["hll_regs"]
+        if self.sharded:
+            self.engine.set_state(bits, regs)
+        else:
+            self.state = self.state._replace(
+                bloom_bits=jax.numpy.asarray(bits),
+                hll_regs=jax.numpy.asarray(regs))
+        self._bank_of = {int(d): b
+                         for d, b in manifest["bank_of"].items()}
+        self._day_base = None
+        self._day_lut.fill(-1)
+        events_path = self._snap_dir / EVENTS_SNAPSHOT
+        if events_path.exists():
+            self.store.truncate()
+            self.store.load(events_path)
+        logger.info("Restored snapshot: %d events, %d HLL banks",
+                    manifest["events"], len(self._bank_of))
+        return True
+
+    def _checkpoint_and_ack(self) -> None:
+        """Barrier: materialize all in-flight outputs, snapshot, then ack
+        — every acknowledged frame is durably in the snapshot."""
+        for _, valid in self._inflight:
+            if valid is not None:
+                jax.block_until_ready(valid)
+        self.snapshot()
+        while self._inflight:
+            msg, _ = self._inflight.popleft()
+            self.consumer.acknowledge(msg)
+
+    # -- ack draining -------------------------------------------------------
     def _drain_inflight(self, block: int = 0) -> None:
         """Ack completed in-flight frames in dispatch order.
 
         ``block`` is how many not-yet-ready head entries to wait for
         (-1 = all).  On depth overflow the hot loop passes 1 — freeing
         exactly one slot instead of collapsing the whole host/device
-        overlap with a full pipeline sync.
+        overlap with a full pipeline sync. With checkpointing on, acks
+        only ever happen at snapshot barriers (_checkpoint_and_ack).
         """
+        if self.checkpointing:
+            return
         while self._inflight:
             msg, valid = self._inflight[0]
             if valid is not None:
@@ -204,6 +365,8 @@ class FusedPipeline:
             try:
                 msg = self.consumer.receive(timeout_millis=50)
             except ReceiveTimeout:
+                if self.checkpointing and self._inflight:
+                    self._checkpoint_and_ack()
                 self._drain_inflight(block=-1)
                 if time.monotonic() - idle_since > idle_timeout_s:
                     break
@@ -220,10 +383,24 @@ class FusedPipeline:
                               self.config, logger)
                 continue
             self._inflight.append((msg, valid))
-            self._drain_inflight(
-                block=1 if len(self._inflight) >= _INFLIGHT_DEPTH else 0)
+            if self.checkpointing:
+                # Barrier on processed-batch cadence, and also on raw
+                # in-flight depth: empty frames never bump
+                # metrics.batches, and the deque (which holds message
+                # bodies) must stay bounded regardless of cadence.
+                if (self.metrics.batches - self._batches_at_snap
+                        >= self._snap_every
+                        or len(self._inflight)
+                        >= max(_INFLIGHT_DEPTH, self._snap_every)):
+                    self._checkpoint_and_ack()
+            else:
+                self._drain_inflight(
+                    block=1 if len(self._inflight) >= _INFLIGHT_DEPTH
+                    else 0)
             if max_events is not None and self.metrics.events >= max_events:
                 break
+        if self.checkpointing and self._inflight:
+            self._checkpoint_and_ack()
         self._drain_inflight(block=-1)
         self.metrics.wall_seconds = time.perf_counter() - t_start
 
@@ -232,6 +409,8 @@ class FusedPipeline:
         bank = self._bank_of.get(int(lecture_day))
         if bank is None:
             return 0
+        if self.sharded:
+            return self.engine.count(bank)
         hist = np.asarray(best_histogram(
             self.state.hll_regs[bank:bank + 1],
             self.config.hll_precision))[0]

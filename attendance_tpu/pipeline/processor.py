@@ -25,6 +25,7 @@ from __future__ import annotations
 import logging
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -78,6 +79,64 @@ class AttendanceProcessor:
         self.sketch = sketch_store or make_sketch_store(self.config)
         self.store = event_store or make_event_store(self.config)
         self.metrics = ProcessorMetrics()
+        # Checkpoint/restore (SURVEY.md §5): honored when snapshot_dir is
+        # set. Sketch state snapshots through utils.snapshot; the event
+        # store participates when it supports save/load (memory/columnar
+        # — Cassandra is externally durable already). With
+        # snapshot_every_batches > 0 the consume loop acks only at
+        # snapshot barriers, so acknowledged events are always durable.
+        self._snap_dir = (Path(self.config.snapshot_dir)
+                          if self.config.snapshot_dir else None)
+        # A set dir with no interval still checkpoints (default cadence):
+        # restore-on-start without further snapshots would lose every
+        # event acked after the restored snapshot on the next crash.
+        self._snap_every = (self.config.snapshot_every_batches
+                            if self.config.snapshot_every_batches > 0
+                            else 64)
+        self._batches_at_snap = 0
+        if self._snap_dir is not None:
+            self.restore()
+
+    SKETCH_SNAPSHOT = "processor_sketch.npz"
+    EVENTS_SNAPSHOT = "processor_events.npz"
+
+    @property
+    def checkpointing(self) -> bool:
+        return self._snap_dir is not None
+
+    def snapshot(self) -> None:
+        """Persist sketch + store state to snapshot_dir (atomic files)."""
+        if self._snap_dir is None:
+            return
+        from attendance_tpu.utils.snapshot import snapshot_sketch_store
+        self._snap_dir.mkdir(parents=True, exist_ok=True)
+        if hasattr(self.sketch, "_blooms"):  # redis keeps its own RDB/AOF
+            snapshot_sketch_store(self.sketch,
+                                  self._snap_dir / self.SKETCH_SNAPSHOT)
+        save = getattr(self.store, "save", None)
+        if save is not None:
+            save(self._snap_dir / self.EVENTS_SNAPSHOT)
+        self._batches_at_snap = self.metrics.batches
+
+    def restore(self) -> bool:
+        """Load the latest snapshot from snapshot_dir, if present."""
+        if self._snap_dir is None:
+            return False
+        restored = False
+        sketch_path = self._snap_dir / self.SKETCH_SNAPSHOT
+        if sketch_path.exists() and hasattr(self.sketch, "_blooms"):
+            from attendance_tpu.utils.snapshot import restore_sketch_store
+            restore_sketch_store(self.sketch, sketch_path)
+            restored = True
+        events_path = self._snap_dir / self.EVENTS_SNAPSHOT
+        load = getattr(self.store, "load", None)
+        if events_path.exists() and load is not None:
+            load(events_path)
+            restored = True
+        if restored:
+            logger.info("Restored processor snapshot from %s",
+                        self._snap_dir)
+        return restored
 
     # -- setup --------------------------------------------------------------
     def setup_bloom_filter(self) -> None:
@@ -176,10 +235,19 @@ class AttendanceProcessor:
         t_start = time.perf_counter()
         idle_since = time.monotonic()
         consecutive_failures = 0
+        pending_acks: List = []  # held until the next snapshot barrier
+
+        def checkpoint_and_ack():
+            self.snapshot()
+            while pending_acks:
+                self.consumer.acknowledge(pending_acks.pop())
+
         try:
             while True:
                 msgs = self._collect_batch()
                 if not msgs:
+                    if pending_acks:
+                        checkpoint_and_ack()
                     if (idle_timeout_s is not None and
                             time.monotonic() - idle_since > idle_timeout_s):
                         break
@@ -219,15 +287,25 @@ class AttendanceProcessor:
                         self.consumer.negative_acknowledge(m)
                     continue
                 # Ack strictly after sketch + store writes committed
-                # (reference attendance_processor.py:132).
-                for m in good_msgs:
-                    self.consumer.acknowledge(m)
+                # (reference attendance_processor.py:132). Under
+                # checkpointing, hold acks until the snapshot barrier so
+                # acknowledged events are always durable.
+                if self.checkpointing:
+                    pending_acks.extend(good_msgs)
+                    if (self.metrics.batches - self._batches_at_snap
+                            >= self._snap_every):
+                        checkpoint_and_ack()
+                else:
+                    for m in good_msgs:
+                        self.consumer.acknowledge(m)
                 if max_events is not None and (
                         self.metrics.events >= max_events):
                     break
         except KeyboardInterrupt:
             logger.info("Stopping attendance processing...")
         finally:
+            if pending_acks:
+                checkpoint_and_ack()
             self.metrics.wall_seconds = time.perf_counter() - t_start
 
     # -- query path ---------------------------------------------------------

@@ -40,9 +40,9 @@ def bench_fused_step(batch_size: int, seconds: float, capacity: int,
     roster = rng.choice(1 << 31, size=capacity, replace=False
                         ).astype(np.uint32)
     # Preload the roster so ~half the stream validates true.
-    from attendance_tpu.models.bloom import bloom_add
+    from attendance_tpu.models.bloom import bloom_add_packed
     state = state._replace(bloom_bits=jax.jit(
-        lambda b, k: bloom_add(b, k, params), donate_argnums=(0,))(
+        lambda b, k: bloom_add_packed(b, k, params), donate_argnums=(0,))(
             state.bloom_bits, jnp.asarray(roster)))
 
     n_bufs = 8  # rotate pre-staged device-resident input batches

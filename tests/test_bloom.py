@@ -62,3 +62,65 @@ def test_duplicate_and_replayed_batches_are_idempotent():
     after = np.asarray(bf.bits).sum()
     assert before == after
     assert bf.contains(np.array([7, 42], dtype=np.uint32)).all()
+
+
+# ---------------------------------------------------------------------------
+# Bit-packed representation (uint32 words, 1/8th the HBM)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("layout", ["flat", "blocked"])
+def test_packed_bit_identical_to_byte_path(layout):
+    """Packed add/contains answer bit-identically to the byte-per-bit
+    path on the same key stream (same bloom_positions underneath)."""
+    import jax.numpy as jnp
+    from attendance_tpu.models.bloom import (
+        bloom_add, bloom_add_packed, bloom_contains, bloom_contains_words,
+        bloom_init, bloom_packed_init, pack_bloom_bits, unpack_bloom_bits)
+
+    rng = np.random.default_rng(11)
+    params = derive_bloom_params(20_000, 0.01, layout)
+    roster = rng.choice(1 << 31, size=10_000, replace=False
+                        ).astype(np.uint32)
+    bits = bloom_add(bloom_init(params), jnp.asarray(roster), params)
+    words = bloom_add_packed(bloom_packed_init(params),
+                             jnp.asarray(roster), params)
+    np.testing.assert_array_equal(
+        np.asarray(pack_bloom_bits(bits)), np.asarray(words))
+    np.testing.assert_array_equal(
+        np.asarray(unpack_bloom_bits(words)), np.asarray(bits))
+
+    probe = np.concatenate([
+        roster[:2_000],
+        rng.integers(1 << 31, 1 << 32, 8_000).astype(np.uint32)])
+    byte_ans = np.asarray(bloom_contains(bits, jnp.asarray(probe), params))
+    word_ans = np.asarray(
+        bloom_contains_words(words, jnp.asarray(probe), params))
+    np.testing.assert_array_equal(byte_ans, word_ans)
+    assert word_ans[:2_000].all()  # no false negatives
+
+    # Masked incremental adds stay identical too.
+    keys2 = rng.integers(0, 1 << 32, 2_048, dtype=np.uint32)
+    mask = rng.random(2_048) < 0.6
+    bits2 = bloom_add(bits, jnp.asarray(keys2), params, jnp.asarray(mask))
+    words2 = bloom_add_packed(words, jnp.asarray(keys2), params,
+                              jnp.asarray(mask))
+    np.testing.assert_array_equal(
+        np.asarray(pack_bloom_bits(bits2)), np.asarray(words2))
+
+
+def test_packed_memory_is_one_eighth():
+    from attendance_tpu.models.bloom import (
+        bloom_init, bloom_packed_init)
+    params = derive_bloom_params(100_000, 0.01, "blocked")
+    assert bloom_packed_init(params).nbytes * 8 == bloom_init(params).nbytes
+
+
+def test_packed_replay_is_idempotent():
+    import jax.numpy as jnp
+    from attendance_tpu.models.bloom import (
+        bloom_add_packed, bloom_packed_init)
+    params = derive_bloom_params(1_000, 0.01, "blocked")
+    keys = jnp.asarray(np.array([7, 7, 7, 42], dtype=np.uint32))
+    words = bloom_add_packed(bloom_packed_init(params), keys, params)
+    again = bloom_add_packed(words, keys, params)
+    np.testing.assert_array_equal(np.asarray(words), np.asarray(again))

@@ -1,0 +1,147 @@
+"""Checkpoint/resume for the fused pipeline (SURVEY.md §5 obligation).
+
+The snapshot is an ack barrier: frames are acknowledged only once their
+outputs are durably in a snapshot, so a crash can only lose work the
+broker still holds — replay into idempotent sketches + the last-write-
+wins store reproduces the uninterrupted result exactly (the reference
+gets the same property from external-service durability + re-entrant
+setup, reference attendance_processor.py:56-72,90-92).
+"""
+
+import numpy as np
+
+from attendance_tpu.config import Config
+from attendance_tpu.pipeline.fast_path import FusedPipeline
+from attendance_tpu.pipeline.loadgen import generate_frames
+from attendance_tpu.transport.memory_broker import MemoryBroker, MemoryClient
+
+NUM_EVENTS, BATCH = 24_000, 2_048
+
+
+def _mkframes(seed=29):
+    return generate_frames(NUM_EVENTS, BATCH, roster_size=8_000,
+                           num_lectures=6, invalid_fraction=0.15, seed=seed)
+
+
+def _final_state(pipe):
+    df = pipe.store.to_dataframe()  # deduplicated, Cassandra-style
+    df = df.sort_values(["lecture_day", "micros", "student_id"]
+                        ).reset_index(drop=True)
+    counts = {day: pipe.count(int(day))
+              for day in df.lecture_day.unique().tolist()}
+    return df, counts
+
+
+def test_crash_replay_resume_matches_uninterrupted(tmp_path):
+    roster, frames = _mkframes()
+    frames = list(frames)
+
+    # --- Reference run: one uninterrupted pipeline, no snapshots. ---
+    config = Config(bloom_filter_capacity=30_000,
+                    transport_backend="memory")
+    client = MemoryClient(MemoryBroker())
+    ref = FusedPipeline(config, client=client, num_banks=8)
+    ref.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    ref.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    ref_df, ref_counts = _final_state(ref)
+
+    # --- Crash run: checkpoint every 3 frames, die mid-stream. ---
+    snap = tmp_path / "snaps"
+    config2 = Config(bloom_filter_capacity=30_000,
+                     transport_backend="memory",
+                     snapshot_dir=str(snap), snapshot_every_batches=3)
+    broker = MemoryBroker()
+    client_a = MemoryClient(broker)
+    a = FusedPipeline(config2, client=client_a, num_banks=8)
+    a.preload(roster)
+    producer = client_a.create_producer(config2.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    # Process ~60% of the stream, then "crash": abandon the pipeline
+    # without its final checkpoint — the consumer close returns every
+    # unacknowledged frame to the shared subscription (crash takeover).
+    a.run(max_events=int(NUM_EVENTS * 0.6), idle_timeout_s=0.5)
+    acked_events = None
+    with np.load(snap / "fused_sketch.npz") as data:
+        import json
+        acked_events = json.loads(bytes(data["manifest"]).decode())["events"]
+    assert acked_events <= a.metrics.events  # barrier acks lag processing
+    a.consumer.close()  # crash: unacked frames redeliver
+
+    # --- Resume: fresh pipeline, same snapshot dir + subscription. ---
+    b = FusedPipeline(config2, client=MemoryClient(broker), num_banks=8)
+    # restore-on-start happened in the constructor:
+    assert b.metrics.events == 0 and b.store.count() > 0
+    b.run(idle_timeout_s=0.5)
+    assert b.consumer.backlog() == 0
+
+    got_df, got_counts = _final_state(b)
+    # Replayed frames were double-processed (at-least-once) but every
+    # sink is idempotent, so the final state matches exactly.
+    assert got_counts == ref_counts
+    assert len(got_df) == len(ref_df)
+    for col in ("student_id", "lecture_day", "micros", "is_valid"):
+        np.testing.assert_array_equal(got_df[col].to_numpy(),
+                                      ref_df[col].to_numpy())
+
+
+def test_restore_requires_matching_filter_geometry(tmp_path):
+    snap = tmp_path / "snaps"
+    config = Config(bloom_filter_capacity=10_000,
+                    transport_backend="memory",
+                    snapshot_dir=str(snap), snapshot_every_batches=1)
+    pipe = FusedPipeline(config, client=MemoryClient(MemoryBroker()),
+                         num_banks=8)
+    pipe.preload(np.arange(100, dtype=np.uint32))
+    pipe.snapshot()
+
+    import pytest
+    bad = Config(bloom_filter_capacity=99_000,
+                 transport_backend="memory",
+                 snapshot_dir=str(snap), snapshot_every_batches=1)
+    with pytest.raises(ValueError, match="capacity"):
+        FusedPipeline(bad, client=MemoryClient(MemoryBroker()),
+                      num_banks=8)
+
+
+def test_processor_snapshot_restore_roundtrip(tmp_path):
+    """AttendanceProcessor honors snapshot_dir/snapshot_every_batches:
+    sketch + store state written at barriers and restored on start."""
+    from attendance_tpu.pipeline.generator import generate_student_data
+    from attendance_tpu.pipeline.processor import AttendanceProcessor
+
+    snap = tmp_path / "proc"
+    config = Config(sketch_backend="memory", transport_backend="memory",
+                    storage_backend="memory", batch_size=64,
+                    batch_timeout_s=0.05,
+                    snapshot_dir=str(snap), snapshot_every_batches=2)
+    broker = MemoryBroker()
+    a = AttendanceProcessor(config, client=MemoryClient(broker))
+    a.setup_bloom_filter()
+    producer = a.client.create_producer(config.pulsar_topic)
+    report = generate_student_data(
+        producer=producer, sketch_store=a.sketch,
+        bloom_key=config.bloom_filter_key,
+        num_students=60, num_invalid=5, seed=31, keep_events=False)
+    a.process_attendance(max_events=report.message_count,
+                         idle_timeout_s=0.5)
+    assert (snap / AttendanceProcessor.SKETCH_SNAPSHOT).exists()
+    assert (snap / AttendanceProcessor.EVENTS_SNAPSHOT).exists()
+    total = a.store.count()
+    lectures = a.store.distinct_lecture_ids()
+    counts = {lec: a.get_attendance_stats(lec)["unique_attendees"]
+              for lec in lectures}
+    a.consumer.close()
+
+    # Fresh processor restores sketches + events without reprocessing.
+    b = AttendanceProcessor(config, client=MemoryClient(broker))
+    assert b.store.count() == total
+    for lec in lectures:
+        assert b.get_attendance_stats(lec)["unique_attendees"] == \
+            counts[lec]
+    # The restored Bloom filter still answers: replay one known event
+    # stream fragment and confirm the bootstrap probe path works.
+    b.setup_bloom_filter()  # "already exists" tolerated
