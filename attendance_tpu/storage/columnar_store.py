@@ -50,28 +50,43 @@ class ColumnarEventStore:
         return n
 
     # -- read path -----------------------------------------------------------
-    def to_dataframe(self, deduplicate: bool = True) -> pd.DataFrame:
-        """Compact all blocks into one DataFrame (analytics entry point)."""
+    def to_columns(self, deduplicate: bool = True) -> Dict[str, np.ndarray]:
+        """Compact all blocks into flat column vectors (analytics entry
+        point — no row objects, no DataFrame)."""
         with self._lock:
             blocks = list(self._blocks)
         if not blocks:
-            return pd.DataFrame(columns=list(_COLS))
-        df = pd.DataFrame({
-            name: np.concatenate([np.asarray(b[name]) for b in blocks])
-            for name in _COLS})
+            return {name: np.zeros(0, np.int64) for name in _COLS}
+        cols = {name: np.concatenate([np.asarray(b[name]) for b in blocks])
+                for name in _COLS}
         if deduplicate:
             # Cassandra PK = (lecture, timestamp, student): last write wins.
-            df = df.drop_duplicates(
-                subset=["lecture_day", "micros", "student_id"], keep="last")
-        return df.reset_index(drop=True)
+            # Stable lexsort with the append index as tiebreaker, then keep
+            # the final row of each equal-PK run.
+            n = len(cols["student_id"])
+            order = np.lexsort((np.arange(n), cols["student_id"],
+                                cols["micros"], cols["lecture_day"]))
+            day = cols["lecture_day"][order]
+            mic = cols["micros"][order]
+            sid = cols["student_id"][order]
+            last = np.ones(n, bool)
+            last[:-1] = ((day[1:] != day[:-1]) | (mic[1:] != mic[:-1])
+                         | (sid[1:] != sid[:-1]))
+            keep = np.sort(order[last])  # original append order
+            cols = {name: arr[keep] for name, arr in cols.items()}
+        return cols
+
+    def to_dataframe(self, deduplicate: bool = True) -> pd.DataFrame:
+        """DataFrame view of :meth:`to_columns` (compat / debugging)."""
+        return pd.DataFrame(self.to_columns(deduplicate=deduplicate))
 
     def count(self) -> int:
         """Distinct primary keys (post-dedup), matching the row stores."""
-        return len(self.to_dataframe())
+        return len(self.to_columns()["student_id"])
 
     def distinct_lecture_days(self) -> List[int]:
-        df = self.to_dataframe(deduplicate=False)
-        return sorted(df["lecture_day"].unique().tolist())
+        days = self.to_columns(deduplicate=False)["lecture_day"]
+        return np.unique(np.asarray(days, np.int64)).tolist()
 
     # -- durability ----------------------------------------------------------
     def save(self, path) -> None:

@@ -16,7 +16,8 @@ from attendance_tpu.pipeline.events import (
     encode_binary_batch, encode_event)
 from attendance_tpu.pipeline.generator import generate_student_data
 from attendance_tpu.pipeline.processor import AttendanceProcessor
-from attendance_tpu.storage.memory_store import MemoryEventStore
+from attendance_tpu.storage.memory_store import (
+    AttendanceRow, MemoryEventStore)
 from attendance_tpu.transport.memory_broker import MemoryBroker, MemoryClient
 
 
@@ -186,3 +187,52 @@ def test_analyzer_empty_store():
     analyzer = AttendanceAnalyzer(MemoryEventStore())
     assert analyzer.generate_insights() == []
     analyzer.print_insights([])
+
+
+def test_analyzer_matches_pandas_oracle():
+    """The columnar numpy aggregations must reproduce the reference's
+    pandas groupby semantics (reference attendance_analysis.py:65-118) —
+    medians, the sample (ddof=1) std, day names, and group counts."""
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(11)
+    store = MemoryEventStore()
+    rows = []
+    for _ in range(3000):
+        sid = int(rng.integers(10_000, 10_060))
+        day = int(rng.integers(1, 28))
+        hour, minute = int(rng.integers(6, 18)), int(rng.integers(0, 60))
+        rows.append(AttendanceRow(
+            sid, f"2026-07-{day:02d}T{hour:02d}:{minute:02d}:00",
+            f"LECTURE_202607{day:02d}", bool(rng.random() < 0.9), "entry"))
+    store.insert_batch(rows)
+    insights = AttendanceAnalyzer(store).generate_insights()
+
+    kept = store.scan_all()  # post-upsert-dedup ground truth
+    df = pd.DataFrame({
+        "student_id": [r.student_id for r in kept],
+        "lecture_id": [r.lecture_id for r in kept],
+        "ts": pd.to_datetime([r.timestamp for r in kept]),
+        "is_valid": [r.is_valid for r in kept]})
+
+    late = df[df.ts.dt.hour >= 9].groupby("student_id").size()
+    exp = late[late > late.median()]
+    assert insights[0]["data"] == {int(k): int(v) for k, v in exp.items()}
+
+    days = df.groupby(df.ts.dt.day_name()).size()
+    assert insights[1]["data"] == {str(k): int(v) for k, v in days.items()}
+
+    counts = df.groupby("student_id").size()
+    exp = counts[counts > counts.median() + counts.std()]
+    assert insights[3]["data"] == {int(k): int(v) for k, v in exp.items()}
+
+    inv = df[~df.is_valid].groupby("student_id").size()
+    assert insights[4]["data"] == {int(k): int(v) for k, v in inv.items()}
+
+    ranked = df.groupby("lecture_id").size().sort_values(ascending=False)
+    got = insights[2]["data"]
+    assert set(got["most_attended"].values()) == set(
+        ranked.head(3).tolist())
+    assert set(got["least_attended"].values()) == set(
+        ranked.tail(3).tolist())
