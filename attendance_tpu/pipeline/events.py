@@ -21,7 +21,7 @@ from __future__ import annotations
 import json
 import struct
 from dataclasses import dataclass
-from datetime import datetime
+from datetime import datetime, timezone
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -88,7 +88,15 @@ def decode_event_batch(frames: Sequence[bytes]) -> List[AttendanceEvent]:
 # ---------------------------------------------------------------------------
 
 def _iso_to_micros(ts: str) -> int:
-    return int(datetime.fromisoformat(ts).timestamp() * 1e6)
+    # Naive timestamps are pinned to UTC so micros is a pure function of
+    # the wall-clock string: `(micros // 3_600e6) % 24` recovers the hour
+    # written in the event on any host timezone, keeping the columnar
+    # analytics path in agreement with the row path (which parses the
+    # string directly).
+    dt = datetime.fromisoformat(ts)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return int(dt.timestamp() * 1e6)
 
 
 def _lecture_to_day(lecture_id: str) -> int:

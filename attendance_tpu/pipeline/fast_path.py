@@ -67,6 +67,11 @@ logger = logging.getLogger(__name__)
 
 _INFLIGHT_DEPTH = 8  # dispatched-but-unacked batches before forcing a sync
 DEFAULT_SNAPSHOT_EVERY = 64  # barrier cadence when only snapshot_dir is set
+# Roster preload runs in fixed-shape chunks: XLA compiles the scatter once
+# (compile time grows superlinearly with update count on TPU; a 1M-key
+# single-shot scatter costs minutes of compile where 2^14-key chunks cost
+# seconds) and every further chunk reuses it.
+_PRELOAD_CHUNK = 1 << 14
 
 SKETCH_SNAPSHOT = "fused_sketch.npz"
 EVENTS_SNAPSHOT = "fused_events.npz"
@@ -142,9 +147,19 @@ class FusedPipeline:
         keys = np.asarray(keys, dtype=np.uint32)
         if self.sharded:
             self.engine.preload(keys)
-        else:
-            self.state = self.state._replace(bloom_bits=self._preload(
-                self.state.bloom_bits, jax.numpy.asarray(keys)))
+            return
+        if len(keys) == 0:
+            return
+        pad = (-len(keys)) % _PRELOAD_CHUNK
+        if pad:
+            # Pad with a repeat of the first key: Bloom add is idempotent.
+            keys = np.concatenate([keys,
+                                   np.full(pad, keys[0], np.uint32)])
+        bits = self.state.bloom_bits
+        for i in range(0, len(keys), _PRELOAD_CHUNK):
+            bits = self._preload(
+                bits, jax.numpy.asarray(keys[i:i + _PRELOAD_CHUNK]))
+        self.state = self.state._replace(bloom_bits=bits)
 
     # -- bank mapping -------------------------------------------------------
     def _num_banks(self) -> int:

@@ -1,24 +1,38 @@
-"""Benchmark rig: sustained events/sec through the fused sketch step.
+"""Benchmark rig: sustained events/sec, kernel-only AND end to end.
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 
-What is measured: the device hot path the north star targets — the fused
-Bloom-validate + HLL-count micro-batch program (the reference's per-event
-BF.EXISTS -> PFADD loop body, reference attendance_processor.py:109-129,
-rebuilt as one XLA dispatch per batch). Keys are pre-staged uint32 batches;
-steps are enqueued back-to-back (donated state, async dispatch) and timed
-end-to-end over `--seconds` of wall clock after a warmup.
+The default mode runs BOTH benchmarks and headlines the honest number:
 
-vs_baseline is measured-throughput / north-star-target (50M ev/s on a
-v5e-8, BASELINE.json); >1.0 beats the target. On the single chip the
-driver runs this against, the per-chip share of the target is 50M/8.
+* ``e2e_pipeline_throughput`` (the headline ``value``) — the full
+  broker -> FusedPipeline -> columnar-store pipe: binary frame receive,
+  zero-copy columnar decode, bank mapping, padding, host->device
+  transfer, the fused Bloom-validate + HLL-count dispatch, the store
+  side-output, and ack-after-commit bookkeeping. This is BASELINE.md
+  bench config #5, the reference's per-event 3-RTT hot loop (reference
+  attendance_processor.py:100-136) measured wall-clock end to end.
+* ``kernel_events_per_sec`` / ``kernel_vs_baseline`` (extra fields) —
+  the device-only fused sketch step over pre-staged device-resident
+  batches (the reference's BF.EXISTS -> PFADD loop body, reference
+  attendance_processor.py:109-129, as one XLA dispatch per batch). The
+  device program's ceiling, excluding all ingress cost.
+
+vs_baseline is measured-throughput / this-run's fair share of the
+north-star target (50M ev/s on a v5e-8, BASELINE.json); >1.0 beats the
+target. On a single chip the per-chip share is 50M/8 = 6.25M ev/s.
+
+A persistent XLA compilation cache is kept next to this file so repeat
+runs skip the (minutes-long on this platform) scatter/fused-step
+compiles; the first run on a fresh checkout pays them once.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -28,8 +42,30 @@ NORTH_STAR_EVENTS_PER_SEC = 50e6  # v5e-8, BASELINE.json
 TARGET_CHIPS = 8
 
 
+def _enable_compilation_cache() -> None:
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          str(Path(__file__).resolve().parent / ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimization, never a requirement
+
+
+def _preload_chunked(preload_fn, bits, roster: np.ndarray):
+    from attendance_tpu.pipeline.fast_path import _PRELOAD_CHUNK
+
+    pad = (-len(roster)) % _PRELOAD_CHUNK
+    if pad:
+        roster = np.concatenate(
+            [roster, np.full(pad, roster[0], np.uint32)])
+    for i in range(0, len(roster), _PRELOAD_CHUNK):
+        bits = preload_fn(bits, jnp.asarray(roster[i:i + _PRELOAD_CHUNK]))
+    return bits
+
+
 def bench_fused_step(batch_size: int, seconds: float, capacity: int,
                      num_banks: int, layout: str) -> dict:
+    from attendance_tpu.models.bloom import bloom_add_packed
     from attendance_tpu.models.fused import init_state, make_jitted_step
 
     state, params = init_state(capacity=capacity, error_rate=0.01,
@@ -40,10 +76,10 @@ def bench_fused_step(batch_size: int, seconds: float, capacity: int,
     roster = rng.choice(1 << 31, size=capacity, replace=False
                         ).astype(np.uint32)
     # Preload the roster so ~half the stream validates true.
-    from attendance_tpu.models.bloom import bloom_add_packed
-    state = state._replace(bloom_bits=jax.jit(
-        lambda b, k: bloom_add_packed(b, k, params), donate_argnums=(0,))(
-            state.bloom_bits, jnp.asarray(roster)))
+    preload = jax.jit(lambda b, k: bloom_add_packed(b, k, params),
+                      donate_argnums=(0,))
+    state = state._replace(
+        bloom_bits=_preload_chunked(preload, state.bloom_bits, roster))
 
     n_bufs = 8  # rotate pre-staged device-resident input batches
     keys_bufs, bank_bufs = [], []
@@ -89,7 +125,10 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
 
     Unlike bench_fused_step this includes the real ingress: binary frame
     decode, bank mapping, padding, host->device transfer, ack-after-
-    commit bookkeeping, and the store side-output.
+    commit bookkeeping, and the store side-output. The backlog is sized
+    as full uniform frames (one padded shape -> one compile) and the run
+    stops exactly when the backlog drains, so no idle-timeout tail pads
+    the measured wall clock.
     """
     from attendance_tpu.config import Config
     from attendance_tpu.pipeline.fast_path import FusedPipeline
@@ -102,8 +141,17 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
     client = MemoryClient(MemoryBroker())
     pipe = FusedPipeline(config, client=client, num_banks=num_banks)
 
-    # Size the run so the broker backlog covers `seconds` of processing.
-    num_events = int(seconds * 25e6)
+    # Size the backlog to cover `seconds` of steady-state processing,
+    # rounded to whole frames so every frame shares one padded shape.
+    # The frame count is capped so the pre-staged broker backlog stays
+    # under ~2 GB and a slow device can't stretch the drain-bound run
+    # past ~8x the requested window.
+    assumed_rate = 25e6
+    bytes_per_event = 18  # BINARY_DTYPE record + frame header amortized
+    cap = max(8, int(2e9 / (batch_size * bytes_per_event)))
+    num_frames = min(max(8, math.ceil(seconds * assumed_rate / batch_size)),
+                     cap)
+    num_events = num_frames * batch_size
     roster, frames = generate_frames(num_events, batch_size,
                                      roster_size=min(capacity, 1_000_000),
                                      num_lectures=num_banks)
@@ -112,49 +160,79 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
     for frame in frames:
         producer.send(frame)
 
-    # warmup one frame size compile
+    # warmup: one frame compiles the (only) padded shape
     pipe.run(max_events=batch_size, idle_timeout_s=0.2)
     pipe.metrics.events = 0
     pipe.metrics.wall_seconds = 0.0
 
-    pipe.run(idle_timeout_s=0.5)
+    pipe.run(max_events=num_events - batch_size, idle_timeout_s=5.0)
     wall = pipe.metrics.wall_seconds
     return {
         "events_per_sec": pipe.metrics.events / wall if wall else 0.0,
         "events": pipe.metrics.events,
+        "batch_size": batch_size,
         "elapsed_s": wall,
+        "device": str(jax.devices()[0]),
     }
+
+
+def _vs_baseline(events_per_sec: float) -> float:
+    n_chips = max(1, len(jax.devices()))
+    # Compare against this run's fair share of the 8-chip north star.
+    target_here = NORTH_STAR_EVENTS_PER_SEC * min(n_chips, TARGET_CHIPS) \
+        / TARGET_CHIPS
+    return events_per_sec / target_here
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", default="kernel", choices=["kernel", "e2e"])
-    ap.add_argument("--batch-size", type=int, default=1 << 20)
+    ap.add_argument("--mode", default="both",
+                    choices=["both", "kernel", "e2e"])
+    ap.add_argument("--batch-size", type=int, default=1 << 20,
+                    help="kernel-mode device batch size")
+    ap.add_argument("--e2e-batch-size", type=int, default=1 << 17,
+                    help="e2e frame size (events per broker frame)")
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--capacity", type=int, default=1_000_000)
     ap.add_argument("--num-banks", type=int, default=64)
     ap.add_argument("--layout", default="blocked",
                     choices=["blocked", "flat"])
     args = ap.parse_args()
+    _enable_compilation_cache()
 
-    if args.mode == "e2e":
-        r = bench_e2e(args.batch_size, args.seconds, args.capacity,
-                      args.num_banks)
-        metric = "e2e_pipeline_throughput"
-    else:
+    if args.mode == "kernel":
         r = bench_fused_step(args.batch_size, args.seconds, args.capacity,
                              args.num_banks, args.layout)
-        metric = "fused_sketch_step_throughput"
-    n_chips = max(1, len(jax.devices()))
-    # Compare against this run's fair share of the 8-chip north star.
-    target_here = NORTH_STAR_EVENTS_PER_SEC * min(n_chips, TARGET_CHIPS) \
-        / TARGET_CHIPS
-    print(json.dumps({
-        "metric": metric,
-        "value": round(r["events_per_sec"], 1),
-        "unit": "events/sec",
-        "vs_baseline": round(r["events_per_sec"] / target_here, 4),
-    }))
+        line = {
+            "metric": "fused_sketch_step_throughput",
+            "value": round(r["events_per_sec"], 1),
+            "unit": "events/sec",
+            "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+        }
+    elif args.mode == "e2e":
+        r = bench_e2e(args.e2e_batch_size, args.seconds, args.capacity,
+                      args.num_banks)
+        line = {
+            "metric": "e2e_pipeline_throughput",
+            "value": round(r["events_per_sec"], 1),
+            "unit": "events/sec",
+            "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+        }
+    else:  # both: headline the honest e2e number, carry kernel alongside
+        e2e = bench_e2e(args.e2e_batch_size, args.seconds, args.capacity,
+                        args.num_banks)
+        kern = bench_fused_step(args.batch_size, args.seconds,
+                                args.capacity, args.num_banks, args.layout)
+        line = {
+            "metric": "e2e_pipeline_throughput",
+            "value": round(e2e["events_per_sec"], 1),
+            "unit": "events/sec",
+            "vs_baseline": round(_vs_baseline(e2e["events_per_sec"]), 4),
+            "kernel_events_per_sec": round(kern["events_per_sec"], 1),
+            "kernel_vs_baseline": round(
+                _vs_baseline(kern["events_per_sec"]), 4),
+        }
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":
