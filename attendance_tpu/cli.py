@@ -117,6 +117,26 @@ def cmd_pipeline(args) -> None:
     processor.cleanup()
 
 
+def cmd_parity(args) -> None:
+    """Differential tpu-vs-redis parity run against a real Redis Stack."""
+    import sys
+
+    from attendance_tpu.parity import RedisUnavailable, run_redis_parity
+
+    config = config_from_args(args)
+    try:
+        report = run_redis_parity(
+            config, num_events=args.num_events,
+            roster_size=args.roster_size,
+            num_lectures=args.num_lectures, seed=args.seed)
+    except RedisUnavailable as e:
+        logger.error("parity run needs a Redis Stack server: %s", e)
+        sys.exit(2)
+    print(report.summary())
+    if not report.ok:
+        sys.exit(1)
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(
         prog="attendance_tpu",
@@ -144,6 +164,16 @@ def main(argv=None) -> None:
     add_flags(p_pipe)
     _add_generate_flags(p_pipe)
     p_pipe.set_defaults(fn=cmd_pipeline)
+
+    p_par = sub.add_parser(
+        "parity", help="differential tpu-vs-redis accuracy check "
+        "(exits 2 when no Redis Stack is reachable)")
+    add_flags(p_par)
+    p_par.add_argument("--num-events", type=int, default=50_000)
+    p_par.add_argument("--roster-size", type=int, default=10_000)
+    p_par.add_argument("--num-lectures", type=int, default=4)
+    p_par.add_argument("--seed", type=int, default=0)
+    p_par.set_defaults(fn=cmd_parity)
 
     args = parser.parse_args(argv)
     args.fn(args)

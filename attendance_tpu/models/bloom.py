@@ -27,6 +27,15 @@ TPU-first design decisions:
 
 Scalable ("chained") filters for BF.ADD beyond capacity live in the store
 layer (sketch/), matching RedisBloom's auto-scaling behavior.
+
+Parity with Redis is STATISTICAL, not bit-level (deliberate deviation
+from SURVEY.md §7 hard parts b-c): this filter hashes uint32
+little-endian key bytes with its own murmur3 seeds, while RedisBloom
+hashes each member's decimal-string bytes with its own seeding —
+individual false positives land on different keys. The contract the
+reference actually depends on is the error budget (no false negatives,
+FPR <= error_rate), which attendance_tpu.parity asserts differentially
+against a live Redis Stack on identical streams.
 """
 
 from __future__ import annotations

@@ -25,6 +25,14 @@ TPU-first design decisions:
     register histogram computed on device — PFCOUNT is off the hot path.
   * Merging replicas/shards (PFMERGE, multi-key PFCOUNT) is element-wise
     register max — the collective used by attendance_tpu.parallel.
+
+Parity with Redis is STATISTICAL, not bit-level (deliberate deviation
+from SURVEY.md §7 hard part a): Redis hashes each member's
+decimal-string bytes with MurmurHash64A; this implementation hashes the
+uint32 little-endian key with two murmur3_32 lanes, so individual
+register values differ between backends. What must (and does) agree is
+the estimate within the ~0.81% sigma / 2% budget, asserted
+differentially by attendance_tpu.parity against a live Redis Stack.
 """
 
 from __future__ import annotations

@@ -71,7 +71,23 @@ DEFAULT_SNAPSHOT_EVERY = 64  # barrier cadence when only snapshot_dir is set
 # (compile time grows superlinearly with update count on TPU; a 1M-key
 # single-shot scatter costs minutes of compile where 2^14-key chunks cost
 # seconds) and every further chunk reuses it.
-_PRELOAD_CHUNK = 1 << 14
+PRELOAD_CHUNK = 1 << 14
+
+
+def chunked_preload(preload_fn, bits, keys: np.ndarray):
+    """Feed keys through a jitted single-chunk Bloom add in fixed-shape
+    chunks of PRELOAD_CHUNK, padding the tail with a repeat of the first
+    key (Bloom add is idempotent). Shared by FusedPipeline.preload and
+    the benchmark rig so both measure the same preload regime."""
+    keys = np.asarray(keys, dtype=np.uint32)
+    if len(keys) == 0:
+        return bits
+    pad = (-len(keys)) % PRELOAD_CHUNK
+    if pad:
+        keys = np.concatenate([keys, np.full(pad, keys[0], np.uint32)])
+    for i in range(0, len(keys), PRELOAD_CHUNK):
+        bits = preload_fn(bits, jax.numpy.asarray(keys[i:i + PRELOAD_CHUNK]))
+    return bits
 
 SKETCH_SNAPSHOT = "fused_sketch.npz"
 EVENTS_SNAPSHOT = "fused_events.npz"
@@ -148,18 +164,8 @@ class FusedPipeline:
         if self.sharded:
             self.engine.preload(keys)
             return
-        if len(keys) == 0:
-            return
-        pad = (-len(keys)) % _PRELOAD_CHUNK
-        if pad:
-            # Pad with a repeat of the first key: Bloom add is idempotent.
-            keys = np.concatenate([keys,
-                                   np.full(pad, keys[0], np.uint32)])
-        bits = self.state.bloom_bits
-        for i in range(0, len(keys), _PRELOAD_CHUNK):
-            bits = self._preload(
-                bits, jax.numpy.asarray(keys[i:i + _PRELOAD_CHUNK]))
-        self.state = self.state._replace(bloom_bits=bits)
+        self.state = self.state._replace(bloom_bits=chunked_preload(
+            self._preload, self.state.bloom_bits, keys))
 
     # -- bank mapping -------------------------------------------------------
     def _num_banks(self) -> int:

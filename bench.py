@@ -51,22 +51,11 @@ def _enable_compilation_cache() -> None:
         pass  # cache is an optimization, never a requirement
 
 
-def _preload_chunked(preload_fn, bits, roster: np.ndarray):
-    from attendance_tpu.pipeline.fast_path import _PRELOAD_CHUNK
-
-    pad = (-len(roster)) % _PRELOAD_CHUNK
-    if pad:
-        roster = np.concatenate(
-            [roster, np.full(pad, roster[0], np.uint32)])
-    for i in range(0, len(roster), _PRELOAD_CHUNK):
-        bits = preload_fn(bits, jnp.asarray(roster[i:i + _PRELOAD_CHUNK]))
-    return bits
-
-
 def bench_fused_step(batch_size: int, seconds: float, capacity: int,
                      num_banks: int, layout: str) -> dict:
     from attendance_tpu.models.bloom import bloom_add_packed
     from attendance_tpu.models.fused import init_state, make_jitted_step
+    from attendance_tpu.pipeline.fast_path import chunked_preload
 
     state, params = init_state(capacity=capacity, error_rate=0.01,
                                layout=layout, num_banks=num_banks)
@@ -79,7 +68,7 @@ def bench_fused_step(batch_size: int, seconds: float, capacity: int,
     preload = jax.jit(lambda b, k: bloom_add_packed(b, k, params),
                       donate_argnums=(0,))
     state = state._replace(
-        bloom_bits=_preload_chunked(preload, state.bloom_bits, roster))
+        bloom_bits=chunked_preload(preload, state.bloom_bits, roster))
 
     n_bufs = 8  # rotate pre-staged device-resident input batches
     keys_bufs, bank_bufs = [], []
@@ -190,14 +179,21 @@ def main() -> None:
                     choices=["both", "kernel", "e2e"])
     ap.add_argument("--batch-size", type=int, default=1 << 20,
                     help="kernel-mode device batch size")
-    ap.add_argument("--e2e-batch-size", type=int, default=1 << 17,
-                    help="e2e frame size (events per broker frame)")
+    ap.add_argument("--e2e-batch-size", type=int, default=None,
+                    help="e2e frame size (events per broker frame); "
+                    "defaults to 2^17, or to --batch-size in e2e mode")
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--capacity", type=int, default=1_000_000)
     ap.add_argument("--num-banks", type=int, default=64)
     ap.add_argument("--layout", default="blocked",
                     choices=["blocked", "flat"])
     args = ap.parse_args()
+    # In pure e2e mode --batch-size keeps its historical meaning (the
+    # frame size); in combined mode it sizes the kernel batch and the
+    # e2e frame size comes from --e2e-batch-size.
+    if args.e2e_batch_size is None:
+        args.e2e_batch_size = (args.batch_size if args.mode == "e2e"
+                               else 1 << 17)
     _enable_compilation_cache()
 
     if args.mode == "kernel":
