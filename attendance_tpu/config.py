@@ -84,6 +84,10 @@ class Config:
     # while acking would lose events).
     snapshot_dir: str = ""
     snapshot_every_batches: int = 0
+    # Profiling ("" = disabled): directory for a jax.profiler trace of
+    # the processing run (TensorBoard/XProf-loadable). Device dispatches
+    # are TraceAnnotation-labelled so kernel time attributes to stages.
+    profile_dir: str = ""
     # Poison-message handling: a frame that fails decode/processing is
     # nacked for redelivery at most this many times, then dead-lettered
     # (acked + counted). The reference nacks forever (no DLQ despite its
@@ -141,6 +145,8 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
     p.add_argument("--snapshot-every-batches", type=int,
                    default=d.snapshot_every_batches)
     p.add_argument("--max-redeliveries", type=int, default=d.max_redeliveries)
+    p.add_argument("--profile-dir", default=d.profile_dir,
+                   help="write a jax.profiler trace of the run here")
     return p
 
 
@@ -168,4 +174,5 @@ def config_from_args(args: argparse.Namespace) -> Config:
         snapshot_dir=args.snapshot_dir,
         snapshot_every_batches=args.snapshot_every_batches,
         max_redeliveries=args.max_redeliveries,
+        profile_dir=args.profile_dir,
     ).validate()

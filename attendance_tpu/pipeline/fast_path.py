@@ -62,6 +62,7 @@ from attendance_tpu.pipeline.processor import ProcessorMetrics
 from attendance_tpu.storage.columnar_store import ColumnarEventStore
 from attendance_tpu.transport import handle_poison, make_client
 from attendance_tpu.transport.memory_broker import ReceiveTimeout
+from attendance_tpu.utils.profiling import maybe_annotate, maybe_trace
 
 logger = logging.getLogger(__name__)
 
@@ -135,6 +136,7 @@ class FusedPipeline:
                 lambda bits, keys: bloom_add_packed(bits, keys,
                                                     self.params),
                 donate_argnums=(0,))
+        self._profiling = bool(self.config.profile_dir)
         self._bank_of: Dict[int, int] = {}
         # Dense day->bank lookup: maps days in [base, base + LUT) with one
         # O(n) fancy-index instead of an O(n log n) np.unique per batch.
@@ -246,7 +248,8 @@ class FusedPipeline:
             return None
         banks = self._banks_for(cols["lecture_day"])
         if self.sharded:
-            valid_n = self.engine.step(cols["student_id"], banks)
+            with maybe_annotate(self._profiling, "sharded_fused_step"):
+                valid_n = self.engine.step(cols["student_id"], banks)
         else:
             padded = 256
             while padded < n:
@@ -257,8 +260,9 @@ class FusedPipeline:
             packed[0, n:] = 0
             packed[1, :n] = banks.view(np.uint32)
             packed[1, n:] = np.uint32(0xFFFFFFFF)  # bank -1: dropped lanes
-            self.state, valid = self._step(self.state,
-                                           jax.numpy.asarray(packed))
+            with maybe_annotate(self._profiling, "fused_step_dispatch"):
+                self.state, valid = self._step(self.state,
+                                               jax.numpy.asarray(packed))
             valid_n = valid[:n]
         self.store.insert_columns({**cols, "is_valid": valid_n})
         self.metrics.batches += 1
@@ -382,6 +386,22 @@ class FusedPipeline:
             idle_timeout_s: float = 1.0) -> None:
         t_start = time.perf_counter()
         idle_since = time.monotonic()
+        with maybe_trace(self.config.profile_dir):
+            self._run_loop(max_events, idle_timeout_s, idle_since)
+        if self.checkpointing and self._inflight:
+            self._checkpoint_and_ack()
+        self._drain_inflight(block=-1)
+        self.metrics.wall_seconds = time.perf_counter() - t_start
+        if logger.isEnabledFor(logging.INFO):
+            # Validity is an async device side-output here (it lands in
+            # the columnar store, not in host counters), so the line
+            # reports it as deferred rather than a misleading 0/0.
+            logger.info("Fused metrics: %s",
+                        self.metrics.summary(self.estimated_fpr(),
+                                             include_validity=False))
+
+    def _run_loop(self, max_events: Optional[int],
+                  idle_timeout_s: float, idle_since: float) -> None:
         while True:
             try:
                 msg = self.consumer.receive(timeout_millis=50)
@@ -420,12 +440,23 @@ class FusedPipeline:
                     else 0)
             if max_events is not None and self.metrics.events >= max_events:
                 break
-        if self.checkpointing and self._inflight:
-            self._checkpoint_and_ack()
-        self._drain_inflight(block=-1)
-        self.metrics.wall_seconds = time.perf_counter() - t_start
 
     # -- queries ------------------------------------------------------------
+    def estimated_fpr(self) -> float:
+        """Occupancy-based FPR estimate of the roster filter: fill^k
+        (slight underestimate for the blocked layout, whose per-block
+        fill variance adds a small penalty — the layout's sizing already
+        compensates by deriving from error_rate/2)."""
+        from attendance_tpu.models.bloom import bloom_packed_fill_fraction
+
+        if self.sharded:
+            words, _ = self.engine.get_state()  # unpadded m_bits//32 words
+            fill = float(bloom_packed_fill_fraction(
+                jax.numpy.asarray(words.reshape(-1))))
+        else:
+            fill = float(bloom_packed_fill_fraction(self.state.bloom_bits))
+        return fill ** self.params.k
+
     def count(self, lecture_day: int) -> int:
         bank = self._bank_of.get(int(lecture_day))
         if bank is None:

@@ -33,6 +33,7 @@ import numpy as np
 from attendance_tpu.config import Config
 from attendance_tpu.pipeline.events import AttendanceEvent, decode_event
 from attendance_tpu.sketch import make_sketch_store
+from attendance_tpu.utils.profiling import maybe_annotate, maybe_trace
 from attendance_tpu.sketch.base import ResponseError
 from attendance_tpu.storage import make_event_store
 from attendance_tpu.storage.memory_store import AttendanceRow
@@ -59,6 +60,27 @@ class ProcessorMetrics:
     def events_per_second(self) -> float:
         return self.events / self.wall_seconds if self.wall_seconds else 0.0
 
+    def summary(self, estimated_fpr: Optional[float] = None,
+                include_validity: bool = True) -> str:
+        """One metrics line (SURVEY.md §5: batch size, device time, FPR
+        estimate alongside the counters). include_validity=False for
+        pipelines whose validity is an async device side-output that
+        never lands in these host counters (the fused path)."""
+        mean_batch = (sum(self.batch_sizes) / len(self.batch_sizes)
+                      if self.batch_sizes else 0.0)
+        fpr = ("n/a" if estimated_fpr is None
+               else f"{estimated_fpr:.4%}")
+        validity = (f"{self.valid_events} valid, "
+                    f"{self.invalid_events} invalid"
+                    if include_validity
+                    else "validity in store (async)")
+        return (f"{self.events} events in {self.batches} batches "
+                f"({self.events_per_second:.0f} ev/s; mean batch "
+                f"{mean_batch:.0f}; device {self.device_seconds:.3f}s; "
+                f"est. bloom FPR {fpr}; {validity}, "
+                f"{self.nacked_batches} nacked, {self.dead_lettered} "
+                f"dead-lettered)")
+
 
 class AttendanceProcessor:
     """Competing consumer turning event frames into sketch + store updates.
@@ -79,6 +101,7 @@ class AttendanceProcessor:
         self.sketch = sketch_store or make_sketch_store(self.config)
         self.store = event_store or make_event_store(self.config)
         self.metrics = ProcessorMetrics()
+        self._profiling = bool(self.config.profile_dir)
         # Checkpoint/restore (SURVEY.md §5): honored when snapshot_dir is
         # set. Sketch state snapshots through utils.snapshot; the event
         # store participates when it supports save/load (memory/columnar
@@ -140,22 +163,34 @@ class AttendanceProcessor:
 
     # -- setup --------------------------------------------------------------
     def setup_bloom_filter(self) -> None:
-        """Reference bootstrap: probe, reserve on error, tolerate existing
-        (reference attendance_processor.py:74-92)."""
+        """Reference bootstrap (attendance_processor.py:74-92): ensure a
+        filter of the CONFIGURED capacity exists before consuming.
+
+        The reference probes BF.EXISTS and reserves when the probe
+        errors — which only works on old RedisBloom versions where
+        BF.EXISTS raised on a missing key. On modern semantics (this
+        framework's contract, sketch/base.py) the probe returns 0
+        silently, the reserve never runs, and the first BF.ADD
+        auto-creates a default capacity-100 scaling chain instead of the
+        configured filter (the FPR metrics line exposed exactly this).
+        So: probe for the reference's log line, then ALWAYS attempt the
+        reserve, tolerating "item exists" — same call shapes, and the
+        configured capacity is guaranteed on every server version."""
+        try:
+            probe = self.sketch.execute_command(
+                "BF.EXISTS", self.config.bloom_filter_key, "test")
+        except ResponseError:  # old-RedisBloom missing-key semantics
+            probe = None
         try:
             self.sketch.execute_command(
-                "BF.EXISTS", self.config.bloom_filter_key, "test")
-            logger.info("Bloom Filter already exists")
-        except ResponseError:
-            try:
-                self.sketch.execute_command(
-                    "BF.RESERVE", self.config.bloom_filter_key,
-                    self.config.bloom_filter_error_rate,
-                    self.config.bloom_filter_capacity)
-                logger.info("Created new Bloom Filter")
-            except ResponseError as e:
-                if "exists" not in str(e):
-                    raise
+                "BF.RESERVE", self.config.bloom_filter_key,
+                self.config.bloom_filter_error_rate,
+                self.config.bloom_filter_capacity)
+            logger.info("Created new Bloom Filter")
+        except ResponseError as e:
+            if "exists" not in str(e):
+                raise
+            logger.info("Bloom Filter already exists (probe=%s)", probe)
 
     # -- core batch step ----------------------------------------------------
     def process_events(self, events: List[AttendanceEvent]) -> np.ndarray:
@@ -170,8 +205,9 @@ class AttendanceProcessor:
         # 1. Batched BF.EXISTS — validity is recomputed, the embedded
         #    ground-truth flag is deliberately ignored (reference
         #    attendance_processor.py:109-113).
-        is_valid = np.asarray(self.sketch.bf_exists_many(
-            self.config.bloom_filter_key, student_ids))
+        with maybe_annotate(self._profiling, "bf_exists_batch"):
+            is_valid = np.asarray(self.sketch.bf_exists_many(
+                self.config.bloom_filter_key, student_ids))
         self.metrics.device_seconds += time.perf_counter() - t0
 
         # 2. Persist every event with computed validity (reference
@@ -191,10 +227,11 @@ class AttendanceProcessor:
         for e, v in zip(events, is_valid):
             if v:
                 by_lecture.setdefault(e.lecture_id, []).append(e.student_id)
-        for lecture_id, members in by_lecture.items():
-            self.sketch.pfadd_many(
-                f"{self.config.hll_key_prefix}{lecture_id}",
-                np.array(members, dtype=np.int64))
+        with maybe_annotate(self._profiling, "pfadd_batch"):
+            for lecture_id, members in by_lecture.items():
+                self.sketch.pfadd_many(
+                    f"{self.config.hll_key_prefix}{lecture_id}",
+                    np.array(members, dtype=np.int64))
         self.metrics.device_seconds += time.perf_counter() - t1
 
         nv = int(is_valid.sum())
@@ -222,6 +259,68 @@ class AttendanceProcessor:
                 break
         return msgs
 
+    def _consume_loop(self, max_events, idle_timeout_s, idle_since,
+                      checkpoint_and_ack, pending_acks) -> None:
+        consecutive_failures = 0
+        while True:
+            msgs = self._collect_batch()
+            if not msgs:
+                if pending_acks:
+                    checkpoint_and_ack()
+                if (idle_timeout_s is not None and
+                        time.monotonic() - idle_since > idle_timeout_s):
+                    break
+                continue
+            idle_since = time.monotonic()
+            # Per-frame decode so one poison frame doesn't poison the
+            # batch: undecodable frames are retried (nack) up to
+            # max_redeliveries, then dead-lettered (acked + counted) —
+            # the bounded version of the reference's nack-forever
+            # (attendance_processor.py:134-136; no DLQ, SURVEY.md §5).
+            good_msgs, events = [], []
+            for m in msgs:
+                try:
+                    events.append(decode_event(m.data()))
+                    good_msgs.append(m)
+                except Exception:
+                    handle_poison(m, self.consumer, self.metrics,
+                                  self.config, logger,
+                                  count_nack=False)
+            try:
+                self.process_events(events)
+                consecutive_failures = 0
+            except Exception:
+                # Whole-batch nack -> broker redelivery; idempotent
+                # sinks make the replay safe (SURVEY.md §5). Unlike
+                # decode poison, processing failures are usually
+                # transient backend faults, so: exponential backoff
+                # before the nack and NO dead-lettering — well-formed
+                # events are never dropped (the reference likewise
+                # retries forever, attendance_processor.py:134-136).
+                logger.exception("Error processing batch; nacking")
+                self.metrics.nacked_batches += 1
+                consecutive_failures += 1
+                time.sleep(min(0.05 * 2 ** min(consecutive_failures, 6),
+                               2.0))
+                for m in good_msgs:
+                    self.consumer.negative_acknowledge(m)
+                continue
+            # Ack strictly after sketch + store writes committed
+            # (reference attendance_processor.py:132). Under
+            # checkpointing, hold acks until the snapshot barrier so
+            # acknowledged events are always durable.
+            if self.checkpointing:
+                pending_acks.extend(good_msgs)
+                if (self.metrics.batches - self._batches_at_snap
+                        >= self._snap_every):
+                    checkpoint_and_ack()
+            else:
+                for m in good_msgs:
+                    self.consumer.acknowledge(m)
+            if max_events is not None and (
+                    self.metrics.events >= max_events):
+                break
+
     def process_attendance(self, max_events: Optional[int] = None,
                            idle_timeout_s: Optional[float] = None) -> None:
         """Long-running consume loop (reference entry point,
@@ -234,7 +333,6 @@ class AttendanceProcessor:
         self.setup_bloom_filter()
         t_start = time.perf_counter()
         idle_since = time.monotonic()
-        consecutive_failures = 0
         pending_acks: List = []  # held until the next snapshot barrier
 
         def checkpoint_and_ack():
@@ -243,70 +341,23 @@ class AttendanceProcessor:
                 self.consumer.acknowledge(pending_acks.pop())
 
         try:
-            while True:
-                msgs = self._collect_batch()
-                if not msgs:
-                    if pending_acks:
-                        checkpoint_and_ack()
-                    if (idle_timeout_s is not None and
-                            time.monotonic() - idle_since > idle_timeout_s):
-                        break
-                    continue
-                idle_since = time.monotonic()
-                # Per-frame decode so one poison frame doesn't poison the
-                # batch: undecodable frames are retried (nack) up to
-                # max_redeliveries, then dead-lettered (acked + counted) —
-                # the bounded version of the reference's nack-forever
-                # (attendance_processor.py:134-136; no DLQ, SURVEY.md §5).
-                good_msgs, events = [], []
-                for m in msgs:
-                    try:
-                        events.append(decode_event(m.data()))
-                        good_msgs.append(m)
-                    except Exception:
-                        handle_poison(m, self.consumer, self.metrics,
-                                      self.config, logger,
-                                      count_nack=False)
-                try:
-                    self.process_events(events)
-                    consecutive_failures = 0
-                except Exception:
-                    # Whole-batch nack -> broker redelivery; idempotent
-                    # sinks make the replay safe (SURVEY.md §5). Unlike
-                    # decode poison, processing failures are usually
-                    # transient backend faults, so: exponential backoff
-                    # before the nack and NO dead-lettering — well-formed
-                    # events are never dropped (the reference likewise
-                    # retries forever, attendance_processor.py:134-136).
-                    logger.exception("Error processing batch; nacking")
-                    self.metrics.nacked_batches += 1
-                    consecutive_failures += 1
-                    time.sleep(min(0.05 * 2 ** min(consecutive_failures, 6),
-                                   2.0))
-                    for m in good_msgs:
-                        self.consumer.negative_acknowledge(m)
-                    continue
-                # Ack strictly after sketch + store writes committed
-                # (reference attendance_processor.py:132). Under
-                # checkpointing, hold acks until the snapshot barrier so
-                # acknowledged events are always durable.
-                if self.checkpointing:
-                    pending_acks.extend(good_msgs)
-                    if (self.metrics.batches - self._batches_at_snap
-                            >= self._snap_every):
-                        checkpoint_and_ack()
-                else:
-                    for m in good_msgs:
-                        self.consumer.acknowledge(m)
-                if max_events is not None and (
-                        self.metrics.events >= max_events):
-                    break
+            with maybe_trace(self.config.profile_dir):
+                self._consume_loop(max_events, idle_timeout_s, idle_since,
+                                   checkpoint_and_ack, pending_acks)
         except KeyboardInterrupt:
             logger.info("Stopping attendance processing...")
         finally:
             if pending_acks:
                 checkpoint_and_ack()
             self.metrics.wall_seconds = time.perf_counter() - t_start
+            if logger.isEnabledFor(logging.INFO):
+                logger.info("Metrics: %s",
+                            self.metrics.summary(self.estimated_fpr()))
+
+    def estimated_fpr(self) -> Optional[float]:
+        """Occupancy-based Bloom FPR estimate for the roster filter
+        (None when the backend's state is not inspectable)."""
+        return self.sketch.estimated_fpr(self.config.bloom_filter_key)
 
     # -- query path ---------------------------------------------------------
     def get_attendance_stats(self, lecture_id: str) -> Dict:

@@ -107,19 +107,30 @@ class ScalableBloom:
         return out
 
     def add_many(self, keys: np.ndarray) -> np.ndarray:
-        """Insert keys; returns per-key 1 if (probably) new, else 0."""
+        """Insert keys; returns per-key 1 if (probably) new, else 0.
+
+        Inserts are sliced across sub-filters so no sub-filter ever takes
+        more distinct keys than its declared capacity — an arbitrarily
+        large batch (larger than the whole remaining chain) grows the
+        chain as many times as needed instead of overfilling the newest
+        sub-filter and blowing its FPR budget.
+        """
         existed = self.contains_many(keys)
         new_keys = keys[~existed]
-        if len(new_keys):
-            if self.counts[-1] + len(new_keys) > self.params[-1].capacity:
-                # Current sub-filter would overflow: chain a bigger one.
-                # (A single batch may still overshoot by < one batch;
-                # the doubled capacity absorbs it.)
+        i = 0
+        while i < len(new_keys):
+            room = self.params[-1].capacity - self.counts[-1]
+            if room <= 0:
                 self._grow()
-            # Distinct inserts, counting within-batch duplicates once.
-            self.counts[-1] += len(np.unique(new_keys))
+                continue
+            chunk = new_keys[i:i + room]
+            # Distinct inserts, counting within-batch duplicates once
+            # (duplicates crossing a slice boundary re-add idempotently
+            # to the newer sub-filter — membership stays correct).
+            self.counts[-1] += len(np.unique(chunk))
             self.filters[-1] = self.store._filter_add(
-                self.filters[-1], self.params[-1], new_keys)
+                self.filters[-1], self.params[-1], chunk)
+            i += len(chunk)
         return (~existed).astype(np.int64)
 
     @property
@@ -212,6 +223,33 @@ class SketchStore(abc.ABC):
 
     def pfcount(self, *keys: str) -> int:
         return self._hll_count(keys)
+
+    # -- observability ------------------------------------------------------
+    def _filter_fill(self, handle, params: BloomParams) -> Optional[float]:
+        """Fraction of set bits of one sub-filter. Works for any backend
+        whose handle is a 0/1 bit-per-element array (tpu, memory);
+        backends without state access (redis) return None."""
+        try:
+            return float(np.mean(np.asarray(handle, dtype=np.float32)))
+        except Exception:  # noqa: BLE001 - opaque handle
+            return None
+
+    def estimated_fpr(self, key: str) -> Optional[float]:
+        """Occupancy-based FPR estimate for one Bloom key: per sub-filter
+        fill^k, combined across the scalable chain as
+        1 - prod(1 - fpr_i) (a query false-positives if ANY sub-filter
+        does). None when the key is absent or the backend's filter state
+        is not inspectable (redis). SURVEY.md §5 per-batch metrics."""
+        bloom = self._blooms.get(key)
+        if bloom is None:
+            return None
+        miss = 1.0
+        for handle, params in zip(bloom.filters, bloom.params):
+            fill = self._filter_fill(handle, params)
+            if fill is None:
+                return None
+            miss *= 1.0 - fill ** params.k
+        return 1.0 - miss
 
     # -- redis-py compatible entry point ------------------------------------
     def execute_command(self, *args):

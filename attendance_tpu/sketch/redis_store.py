@@ -13,7 +13,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from attendance_tpu.sketch.base import SketchStore
+from attendance_tpu.sketch.base import ResponseError, SketchStore
 
 try:
     import redis as _redis
@@ -23,6 +23,18 @@ except ImportError:  # pragma: no cover - environment without redis-py
     HAVE_REDIS = False
 
 _BATCH = 512  # members per BF.MADD/MEXISTS chunk
+
+
+def _translated(fn):
+    """Re-raise redis.exceptions.ResponseError as the facade's
+    ResponseError so callers (processor bootstrap, parity harness) catch
+    ONE exception type across every backend."""
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except _redis.exceptions.ResponseError as e:
+            raise ResponseError(str(e)) from e
+    return wrapper
 
 
 class RedisSketchStore(SketchStore):
@@ -53,9 +65,11 @@ class RedisSketchStore(SketchStore):
     def _hll_count(self, keys):  # pragma: no cover
         raise NotImplementedError
 
+    @_translated
     def execute_command(self, *args):
         return self.client.execute_command(*args)
 
+    @_translated
     def bf_reserve(self, key, error_rate, capacity):
         return self.client.execute_command(
             "BF.RESERVE", key, error_rate, capacity)

@@ -1,0 +1,37 @@
+"""Flag-gated jax.profiler hooks (SURVEY.md §5 observability obligation).
+
+The reference's only observability is log cadence (reference
+attendance_processor.py:131, data_generator.py:155-156). The TPU
+framework's obligation is device-level visibility: when
+``--profile-dir`` is set, the processing run is wrapped in
+``jax.profiler.trace`` (a TensorBoard/XProf-loadable artifact is written
+under the directory) and each device dispatch carries a
+``TraceAnnotation`` so kernel time attributes to pipeline stages. With
+the flag unset every hook is a no-op nullcontext — nothing is imported,
+nothing is timed, the hot loop pays nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+
+def maybe_trace(profile_dir: Optional[str]):
+    """``jax.profiler.trace(profile_dir)`` when set, else a nullcontext."""
+    if not profile_dir:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.trace(str(profile_dir))
+
+
+def maybe_annotate(enabled: bool, name: str):
+    """``jax.profiler.TraceAnnotation(name)`` when profiling, else a
+    nullcontext (TraceAnnotation costs a TraceMe even with no active
+    trace, so the hot loop skips it entirely when disabled)."""
+    if not enabled:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

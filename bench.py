@@ -187,6 +187,8 @@ def main() -> None:
     ap.add_argument("--num-banks", type=int, default=64)
     ap.add_argument("--layout", default="blocked",
                     choices=["blocked", "flat"])
+    ap.add_argument("--profile-dir", default="",
+                    help="write a jax.profiler trace of the bench here")
     args = ap.parse_args()
     # In pure e2e mode --batch-size keeps its historical meaning (the
     # frame size); in combined mode it sizes the kernel batch and the
@@ -195,39 +197,43 @@ def main() -> None:
         args.e2e_batch_size = (args.batch_size if args.mode == "e2e"
                                else 1 << 17)
     _enable_compilation_cache()
+    from attendance_tpu.utils.profiling import maybe_trace
 
-    if args.mode == "kernel":
-        r = bench_fused_step(args.batch_size, args.seconds, args.capacity,
-                             args.num_banks, args.layout)
-        line = {
-            "metric": "fused_sketch_step_throughput",
-            "value": round(r["events_per_sec"], 1),
-            "unit": "events/sec",
-            "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
-        }
-    elif args.mode == "e2e":
-        r = bench_e2e(args.e2e_batch_size, args.seconds, args.capacity,
-                      args.num_banks)
-        line = {
-            "metric": "e2e_pipeline_throughput",
-            "value": round(r["events_per_sec"], 1),
-            "unit": "events/sec",
-            "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
-        }
-    else:  # both: headline the honest e2e number, carry kernel alongside
-        e2e = bench_e2e(args.e2e_batch_size, args.seconds, args.capacity,
-                        args.num_banks)
-        kern = bench_fused_step(args.batch_size, args.seconds,
-                                args.capacity, args.num_banks, args.layout)
-        line = {
-            "metric": "e2e_pipeline_throughput",
-            "value": round(e2e["events_per_sec"], 1),
-            "unit": "events/sec",
-            "vs_baseline": round(_vs_baseline(e2e["events_per_sec"]), 4),
-            "kernel_events_per_sec": round(kern["events_per_sec"], 1),
-            "kernel_vs_baseline": round(
-                _vs_baseline(kern["events_per_sec"]), 4),
-        }
+    with maybe_trace(args.profile_dir):
+        if args.mode == "kernel":
+            r = bench_fused_step(args.batch_size, args.seconds,
+                                 args.capacity, args.num_banks, args.layout)
+            line = {
+                "metric": "fused_sketch_step_throughput",
+                "value": round(r["events_per_sec"], 1),
+                "unit": "events/sec",
+                "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+            }
+        elif args.mode == "e2e":
+            r = bench_e2e(args.e2e_batch_size, args.seconds, args.capacity,
+                          args.num_banks)
+            line = {
+                "metric": "e2e_pipeline_throughput",
+                "value": round(r["events_per_sec"], 1),
+                "unit": "events/sec",
+                "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+            }
+        else:  # both: headline the honest e2e number + kernel alongside
+            e2e = bench_e2e(args.e2e_batch_size, args.seconds,
+                            args.capacity, args.num_banks)
+            kern = bench_fused_step(args.batch_size, args.seconds,
+                                    args.capacity, args.num_banks,
+                                    args.layout)
+            line = {
+                "metric": "e2e_pipeline_throughput",
+                "value": round(e2e["events_per_sec"], 1),
+                "unit": "events/sec",
+                "vs_baseline": round(
+                    _vs_baseline(e2e["events_per_sec"]), 4),
+                "kernel_events_per_sec": round(kern["events_per_sec"], 1),
+                "kernel_vs_baseline": round(
+                    _vs_baseline(kern["events_per_sec"]), 4),
+            }
     print(json.dumps(line))
 
 

@@ -1,0 +1,119 @@
+"""Profiling + FPR observability tests (SURVEY.md §5 obligation).
+
+Covers: the occupancy-based Bloom FPR estimator on the store facade and
+the fused pipeline, its appearance in the per-run metrics line, and the
+flag-gated jax.profiler trace artifact.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from attendance_tpu.config import Config
+from attendance_tpu.pipeline.processor import ProcessorMetrics
+from attendance_tpu.sketch.memory_store import MemorySketchStore
+from attendance_tpu.sketch.tpu_store import TpuSketchStore
+
+
+@pytest.mark.parametrize("store_cls", [TpuSketchStore, MemorySketchStore])
+def test_estimated_fpr_tracks_fill(store_cls):
+    store = store_cls(Config())
+    assert store.estimated_fpr("bf") is None  # absent key
+    store.execute_command("BF.RESERVE", "bf", 0.01, 10_000)
+    assert store.estimated_fpr("bf") == 0.0  # empty filter
+    rng = np.random.default_rng(0)
+    keys = rng.choice(1 << 30, size=10_000, replace=False).astype(np.uint32)
+    store.bf_add_many("bf", keys[:1_000])
+    light = store.estimated_fpr("bf")
+    store.bf_add_many("bf", keys[1_000:])
+    full = store.estimated_fpr("bf")
+    # Estimate grows with occupancy and lands near the configured 1%
+    # at declared capacity.
+    assert 0.0 < light < full
+    assert 0.002 < full < 0.02
+
+
+def test_estimated_fpr_spans_scalable_chain():
+    store = MemorySketchStore(Config())
+    store.execute_command("BF.RESERVE", "bf", 0.01, 500)
+    keys = np.arange(2_000, dtype=np.uint32) + 7
+    store.bf_add_many("bf", keys)  # forces chained sub-filters
+    assert len(store._blooms["bf"].filters) > 1
+    est = store.estimated_fpr("bf")
+    assert 0.0 < est < 0.04  # chain budget is <= 2 * base error
+
+
+def test_fused_pipeline_estimated_fpr_and_metrics_line(tmp_path):
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(bloom_filter_capacity=5_000)
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    assert pipe.estimated_fpr() == 0.0
+    roster, frames = generate_frames(4_096, 2_048, roster_size=5_000,
+                                     num_lectures=4)
+    pipe.preload(roster)
+    est = pipe.estimated_fpr()
+    assert 0.001 < est < 0.02
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(idle_timeout_s=0.2)
+    assert pipe.metrics.events == 4_096
+    line = pipe.metrics.summary(pipe.estimated_fpr())
+    assert "est. bloom FPR" in line and "%" in line
+    assert "4096 events" in line
+
+
+def test_metrics_summary_handles_missing_fpr():
+    m = ProcessorMetrics()
+    m.events, m.batches, m.wall_seconds = 10, 1, 1.0
+    assert "est. bloom FPR n/a" in m.summary(None)
+
+
+def test_profile_flag_writes_trace_artifact(tmp_path):
+    from attendance_tpu.pipeline.processor import AttendanceProcessor
+    from attendance_tpu.pipeline.generator import generate_student_data
+
+    profile_dir = tmp_path / "prof"
+    config = Config(sketch_backend="memory", profile_dir=str(profile_dir),
+                    batch_timeout_s=0.01)
+    processor = AttendanceProcessor(config)
+    processor.setup_bloom_filter()
+    producer = processor.client.create_producer(config.pulsar_topic)
+    report = generate_student_data(
+        producer=producer, sketch_store=processor.sketch,
+        num_students=20, num_invalid=2, seed=0, keep_events=False)
+    processor.process_attendance(max_events=report.message_count,
+                                 idle_timeout_s=0.3)
+    processor.cleanup()
+    # jax.profiler.trace writes a plugins/profile/<run>/ tree with at
+    # least one .xplane.pb (or trace.json.gz) artifact.
+    artifacts = list(profile_dir.rglob("*"))
+    assert any(p.is_file() for p in artifacts), (
+        f"no profile artifact under {profile_dir}")
+
+
+def test_processor_metrics_line_logged(caplog):
+    from attendance_tpu.pipeline.processor import AttendanceProcessor
+    from attendance_tpu.pipeline.generator import generate_student_data
+
+    config = Config(sketch_backend="memory", batch_timeout_s=0.01)
+    processor = AttendanceProcessor(config)
+    processor.setup_bloom_filter()
+    producer = processor.client.create_producer(config.pulsar_topic)
+    report = generate_student_data(
+        producer=producer, sketch_store=processor.sketch,
+        num_students=20, num_invalid=2, seed=0, keep_events=False)
+    with caplog.at_level(logging.INFO,
+                         logger="attendance_tpu.pipeline.processor"):
+        processor.process_attendance(max_events=report.message_count,
+                                     idle_timeout_s=0.3)
+    processor.cleanup()
+    metrics_lines = [r.getMessage() for r in caplog.records
+                     if "est. bloom FPR" in r.getMessage()]
+    assert metrics_lines
