@@ -71,16 +71,53 @@ def make_jitted_step(params: BloomParams, precision: int = 14,
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
-def fused_step_packed(state: SketchState, packed: jax.Array,
-                      params: BloomParams,
-                      precision: int = 14) -> Tuple[SketchState, jax.Array]:
-    """fused_step over ONE combined input buffer: uint32[2, B] with row 0
-    = keys and row 1 = bank ids (int32 bit pattern; -1 = padded/ignored
-    lane). Halves the per-batch host->device round trips versus separate
-    keys/banks/mask transfers — the mask is subsumed by bank -1, which the
-    HLL scatter already drops."""
-    keys = packed[0]
-    bank_idx = packed[1].astype(jnp.int32)
+# ---------------------------------------------------------------------------
+# Byte-packed wire: (4 + w) bytes/event instead of 8
+# ---------------------------------------------------------------------------
+
+def bank_wire_dtype(num_banks: int):
+    """Smallest unsigned dtype for bank ids on the wire; the dtype's max
+    value is reserved as the padded-lane sentinel, so up to
+    ``iinfo(dtype).max`` banks are addressable."""
+    import numpy as np
+
+    if num_banks <= 0xFF:
+        return np.uint8
+    if num_banks <= 0xFFFF:
+        return np.uint16
+    return np.uint32
+
+
+def fused_step_bytes(state: SketchState, buf: jax.Array,
+                     params: BloomParams, bank_itemsize: int,
+                     precision: int = 14) -> Tuple[SketchState, jax.Array]:
+    """fused_step over ONE byte buffer: uint8[(4 + w) * B] laid out as
+    [keys as B little-endian uint32 | bank ids as B uint{8w}] with the
+    bank dtype's max value marking padded lanes.
+
+    The uplink is the scarce resource between host and device (PCIe on a
+    real host, the relay tunnel here): 5 bytes/event for <=255 banks
+    versus the 8 bytes/event of the [2, B] uint32 layout is a 1.6x
+    higher event ceiling at the same link rate.
+    """
+    w = bank_itemsize
+    B = buf.shape[0] // (4 + w)
+    keys = jax.lax.bitcast_convert_type(
+        buf[:4 * B].reshape(B, 4), jnp.uint32)
+    raw = buf[4 * B:]
+    if w == 1:
+        banks_u = raw
+        sentinel = jnp.uint8(0xFF)
+    elif w == 2:
+        banks_u = jax.lax.bitcast_convert_type(
+            raw.reshape(B, 2), jnp.uint16)
+        sentinel = jnp.uint16(0xFFFF)
+    else:
+        banks_u = jax.lax.bitcast_convert_type(
+            raw.reshape(B, 4), jnp.uint32)
+        sentinel = jnp.uint32(0xFFFFFFFF)
+    bank_idx = jnp.where(banks_u == sentinel, jnp.int32(-1),
+                         banks_u.astype(jnp.int32))
     valid = bloom_contains_words(state.bloom_bits, keys, params)
     regs = hll_add(state.hll_regs,
                    jnp.where(valid, bank_idx, -1),
@@ -88,7 +125,8 @@ def fused_step_packed(state: SketchState, packed: jax.Array,
     return SketchState(state.bloom_bits, regs), valid
 
 
-def make_jitted_step_packed(params: BloomParams, precision: int = 14):
-    fn = lambda state, packed: fused_step_packed(
-        state, packed, params, precision)
+def make_jitted_step_bytes(params: BloomParams, bank_itemsize: int,
+                           precision: int = 14):
+    fn = lambda state, buf: fused_step_bytes(
+        state, buf, params, bank_itemsize, precision)
     return jax.jit(fn, donate_argnums=(0,))

@@ -135,25 +135,33 @@ def encode_binary_batch(events: Sequence[AttendanceEvent]) -> bytes:
     return BINARY_MAGIC + rec.tobytes()
 
 
-def decode_binary_batch(data: bytes) -> Dict[str, np.ndarray]:
+def decode_binary_batch(data: bytes,
+                        include_truth: bool = True) -> Dict[str, np.ndarray]:
     """Zero-copy columnar decode of one binary frame -> column arrays.
 
     Accepts both the interleaved record format (ATB1) and the planar
     format (ATB2); prefer planar on the hot path — its column views are
     contiguous, so the device transfer needs no host gather/copy first.
+
+    include_truth=False skips materializing the generator's embedded
+    ``is_valid`` ground-truth column (the processor recomputes validity
+    and discards it, reference attendance_processor.py:109-113 — no
+    point allocating it per frame on the hot path).
     """
     if data.startswith(PLANAR_MAGIC):
-        return decode_planar_batch(data)
+        return decode_planar_batch(data, include_truth)
     if not data.startswith(BINARY_MAGIC):
         raise ValueError("not a binary event frame")
     rec = np.frombuffer(data, dtype=BINARY_DTYPE, offset=len(BINARY_MAGIC))
-    return {
+    cols = {
         "student_id": rec["student_id"],
         "lecture_day": rec["lecture_day"],
         "micros": rec["micros"],
-        "is_valid": (rec["flags"] & 1).astype(bool),
         "event_type": ((rec["flags"] >> 1) & 1).astype(np.int8),
     }
+    if include_truth:
+        cols["is_valid"] = (rec["flags"] & 1).astype(bool)
+    return cols
 
 
 # ---------------------------------------------------------------------------
@@ -181,7 +189,8 @@ def encode_planar_batch(cols: Dict[str, np.ndarray]) -> bytes:
     return b"".join(parts)
 
 
-def decode_planar_batch(data: bytes) -> Dict[str, np.ndarray]:
+def decode_planar_batch(data: bytes,
+                        include_truth: bool = True) -> Dict[str, np.ndarray]:
     """Zero-copy decode: every column is a contiguous buffer view."""
     if not data.startswith(PLANAR_MAGIC):
         raise ValueError("not a planar event frame")
@@ -196,13 +205,15 @@ def decode_planar_batch(data: bytes) -> Dict[str, np.ndarray]:
     micros = np.frombuffer(data, np.int64, count=n, offset=off)
     off += 8 * n
     flags = np.frombuffer(data, np.uint8, count=n, offset=off)
-    return {
+    cols = {
         "student_id": student,
         "lecture_day": day,
         "micros": micros,
-        "is_valid": (flags & 1).astype(bool),
         "event_type": ((flags >> 1) & 1).astype(np.int8),
     }
+    if include_truth:
+        cols["is_valid"] = (flags & 1).astype(bool)
+    return cols
 
 
 def columns_from_events(events: Sequence[AttendanceEvent]

@@ -11,7 +11,8 @@ the generic SketchStore API).
 Execution backends, selected by config:
   * single chip (num_shards * num_replicas == 1): bit-packed Bloom words
     + HLL banks resident on one device, one fused jitted dispatch per
-    frame with a combined [2, B] input transfer.
+    frame with a combined byte-packed input transfer ((4 + w) bytes per
+    event: uint32 key + narrow bank id, models.fused.fused_step_bytes).
   * sharded (product > 1): the same sketches partitioned over a
     (dp, sp) jax.sharding.Mesh via parallel.ShardedSketchEngine —
     hash-range Bloom/HLL shards, AND-across-shards queries, register-max
@@ -54,7 +55,8 @@ import numpy as np
 
 from attendance_tpu.config import Config
 from attendance_tpu.models.bloom import bloom_add_packed
-from attendance_tpu.models.fused import init_state, make_jitted_step_packed
+from attendance_tpu.models.fused import (
+    bank_wire_dtype, init_state, make_jitted_step_bytes)
 from attendance_tpu.models.hll import (
     best_histogram, estimate_from_histogram)
 from attendance_tpu.pipeline.events import decode_binary_batch
@@ -130,8 +132,10 @@ class FusedPipeline:
                 layout="blocked",
                 num_banks=num_banks,
                 precision=self.config.hll_precision)
-            self._step = make_jitted_step_packed(
-                self.params, self.config.hll_precision)
+            self._bank_dtype = bank_wire_dtype(num_banks)
+            self._step = make_jitted_step_bytes(
+                self.params, np.dtype(self._bank_dtype).itemsize,
+                self.config.hll_precision)
             self._preload = jax.jit(
                 lambda bits, keys: bloom_add_packed(bits, keys,
                                                     self.params),
@@ -177,12 +181,19 @@ class FusedPipeline:
     def _grow_banks(self) -> None:
         if self.sharded:
             self.engine.grow_banks(self.engine.num_banks * 2)
-        else:
-            regs = self.state.hll_regs
-            grown = jax.numpy.zeros(
-                (regs.shape[0] * 2, regs.shape[1]), regs.dtype)
-            self.state = self.state._replace(
-                hll_regs=grown.at[:regs.shape[0]].set(regs))
+            return
+        regs = self.state.hll_regs
+        grown = jax.numpy.zeros(
+            (regs.shape[0] * 2, regs.shape[1]), regs.dtype)
+        self.state = self.state._replace(
+            hll_regs=grown.at[:regs.shape[0]].set(regs))
+        new_dtype = bank_wire_dtype(regs.shape[0] * 2)
+        if new_dtype is not self._bank_dtype:
+            # Wire dtype widens past the sentinel limit: new step program.
+            self._bank_dtype = new_dtype
+            self._step = make_jitted_step_bytes(
+                self.params, np.dtype(new_dtype).itemsize,
+                self.config.hll_precision)
 
     def _register_day(self, day: int) -> int:
         bank = self._bank_of.get(day)
@@ -208,23 +219,38 @@ class FusedPipeline:
                 self._day_lut[off] = bank
 
     def _banks_for(self, lecture_days: np.ndarray) -> np.ndarray:
-        """Vectorized day->bank: one fancy-index through the dense LUT;
-        unseen/out-of-window days take the scalar slow path (rare —
-        calendar days are few and clustered)."""
-        days = lecture_days.astype(np.int64)
+        """Vectorized day->bank through the dense LUT.
+
+        Hot path (every steady-state frame: all days already registered
+        and inside the window): ONE uint32 subtract, a min/max guard,
+        one np.take, one >=0 check — ~4 passes over int32 data, no
+        boolean masking temporaries. The general path (new or
+        out-of-window days — rare, calendar days are few and clustered)
+        registers the missing days and re-resolves only the missed
+        lanes."""
+        days_u32 = np.ascontiguousarray(lecture_days, dtype=np.uint32)
         if self._day_base is None:
+            self._rebuild_lut(int(days_u32.min()))
+        # uint32 wraparound keeps day<base negative after the int32
+        # reinterpret (calendar deltas never approach 2^31).
+        off = (days_u32 - np.uint32(self._day_base)).view(np.int32)
+        mn, mx = int(off.min()), int(off.max())
+        if 0 <= mn and mx < self._LUT_SIZE:
+            banks = np.take(self._day_lut, off)
+            if banks.min() >= 0:
+                return banks
+        return self._banks_for_slow(days_u32.astype(np.int64))
+
+    def _banks_for_slow(self, days: np.ndarray) -> np.ndarray:
+        if int(days.min()) < self._day_base:
             self._rebuild_lut(int(days.min()))
         off = days - self._day_base
         in_range = (off >= 0) & (off < self._LUT_SIZE)
-        banks = np.full(len(days), -1, np.int32)
-        idx = np.where(in_range, off, 0)
-        banks = np.where(in_range, self._day_lut[idx], -1)
+        banks = np.where(in_range,
+                         self._day_lut[np.where(in_range, off, 0)], -1)
         misses = banks < 0
         if misses.any():
-            miss_days = np.unique(days[misses])
-            if int(miss_days.min()) < self._day_base:
-                self._rebuild_lut(int(miss_days.min()))
-            for day in miss_days.tolist():
+            for day in np.unique(days[misses]).tolist():
                 self._register_day(int(day))
             # re-resolve only the missed lanes
             moff = days[misses] - self._day_base
@@ -236,13 +262,15 @@ class FusedPipeline:
                 fixed[still] = [self._bank_of[int(d)]
                                 for d in vals.tolist()]
             banks[misses] = fixed
-        return banks
+        return banks.astype(np.int32, copy=False)
 
     # -- hot loop -----------------------------------------------------------
     def process_frame(self, data: bytes):
         """Dispatch one bulk binary frame; returns the async validity."""
         t0 = time.perf_counter()
-        cols = decode_binary_batch(data)
+        # Skip the embedded ground-truth column: validity is recomputed
+        # on device and the store gets the computed vector.
+        cols = decode_binary_batch(data, include_truth=False)
         n = len(cols["student_id"])
         if n == 0:
             return None
@@ -254,15 +282,20 @@ class FusedPipeline:
             padded = 256
             while padded < n:
                 padded *= 2
-            # ONE combined transfer: row 0 keys, row 1 bank ids (-1 pads).
-            packed = np.empty((2, padded), np.uint32)
-            packed[0, :n] = cols["student_id"]
-            packed[0, n:] = 0
-            packed[1, :n] = banks.view(np.uint32)
-            packed[1, n:] = np.uint32(0xFFFFFFFF)  # bank -1: dropped lanes
+            # ONE combined byte-packed transfer: B little-endian uint32
+            # keys then B narrow bank ids (dtype max = padded lane) —
+            # (4 + w) bytes/event on the host->device link instead of 8.
+            w = np.dtype(self._bank_dtype).itemsize
+            buf = np.empty((4 + w) * padded, np.uint8)
+            kv = buf[:4 * padded].view(np.uint32)
+            kv[:n] = cols["student_id"]
+            kv[n:] = 0
+            bv = buf[4 * padded:].view(self._bank_dtype)
+            bv[:n] = banks  # all < num_banks <= sentinel
+            bv[n:] = np.iinfo(self._bank_dtype).max
             with maybe_annotate(self._profiling, "fused_step_dispatch"):
                 self.state, valid = self._step(self.state,
-                                               jax.numpy.asarray(packed))
+                                               jax.numpy.asarray(buf))
             valid_n = valid[:n]
         self.store.insert_columns({**cols, "is_valid": valid_n})
         self.metrics.batches += 1
@@ -331,6 +364,17 @@ class FusedPipeline:
             self.state = self.state._replace(
                 bloom_bits=jax.numpy.asarray(bits),
                 hll_regs=jax.numpy.asarray(regs))
+            # The snapshot may hold more banks than this construction
+            # (growth before the crash): re-derive the wire dtype and
+            # step program from the RESTORED bank count, or bank ids
+            # above the old sentinel would narrow-cast into the wrong
+            # banks.
+            new_dtype = bank_wire_dtype(regs.shape[0])
+            if new_dtype is not self._bank_dtype:
+                self._bank_dtype = new_dtype
+                self._step = make_jitted_step_bytes(
+                    self.params, np.dtype(new_dtype).itemsize,
+                    self.config.hll_precision)
         self._bank_of = {int(d): b
                          for d, b in manifest["bank_of"].items()}
         self._day_base = None

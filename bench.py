@@ -144,23 +144,39 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
     roster, frames = generate_frames(num_events, batch_size,
                                      roster_size=min(capacity, 1_000_000),
                                      num_lectures=num_banks)
+    frames = list(frames)
     pipe.preload(roster)
     producer = client.create_producer(config.pulsar_topic)
-    for frame in frames:
-        producer.send(frame)
 
     # warmup: one frame compiles the (only) padded shape
+    producer.send(frames[0])
     pipe.run(max_events=batch_size, idle_timeout_s=0.2)
-    pipe.metrics.events = 0
-    pipe.metrics.wall_seconds = 0.0
 
-    pipe.run(max_events=num_events - batch_size, idle_timeout_s=5.0)
-    wall = pipe.metrics.wall_seconds
+    # Three measured passes over the same backlog (frame bytes are
+    # re-sent by reference — no regeneration); the MEDIAN rate is
+    # reported. A single drain-bound pass on a shared host/tunnel sees
+    # multi-x run-to-run jitter; the median is stable.
+    rates = []
+    for _ in range(3):
+        for frame in frames:
+            producer.send(frame)
+        pipe.metrics.events = 0
+        pipe.metrics.wall_seconds = 0.0
+        pipe.run(max_events=num_events, idle_timeout_s=5.0)
+        if pipe.metrics.wall_seconds:
+            rates.append(pipe.metrics.events / pipe.metrics.wall_seconds)
+        # Keep every pass identical: drop the append-only store's blocks
+        # (each pass would otherwise retain ~num_events device-resident
+        # validity lanes plus host column copies).
+        pipe.store.truncate()
+    rates.sort()
+    median = rates[len(rates) // 2] if rates else 0.0
     return {
-        "events_per_sec": pipe.metrics.events / wall if wall else 0.0,
-        "events": pipe.metrics.events,
+        "events_per_sec": median,
+        "events": num_events,
+        "rates": [round(r, 1) for r in rates],
         "batch_size": batch_size,
-        "elapsed_s": wall,
+        "elapsed_s": pipe.metrics.wall_seconds,
         "device": str(jax.devices()[0]),
     }
 
@@ -181,7 +197,7 @@ def main() -> None:
                     help="kernel-mode device batch size")
     ap.add_argument("--e2e-batch-size", type=int, default=None,
                     help="e2e frame size (events per broker frame); "
-                    "defaults to 2^17, or to --batch-size in e2e mode")
+                    "defaults to 2^19, or to --batch-size in e2e mode")
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--capacity", type=int, default=1_000_000)
     ap.add_argument("--num-banks", type=int, default=64)
@@ -195,7 +211,7 @@ def main() -> None:
     # e2e frame size comes from --e2e-batch-size.
     if args.e2e_batch_size is None:
         args.e2e_batch_size = (args.batch_size if args.mode == "e2e"
-                               else 1 << 17)
+                               else 1 << 19)
     _enable_compilation_cache()
     from attendance_tpu.utils.profiling import maybe_trace
 

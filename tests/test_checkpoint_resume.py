@@ -145,3 +145,56 @@ def test_processor_snapshot_restore_roundtrip(tmp_path):
     # The restored Bloom filter still answers: replay one known event
     # stream fragment and confirm the bootstrap probe path works.
     b.setup_bloom_filter()  # "already exists" tolerated
+
+
+def test_restore_across_bank_dtype_boundary(tmp_path):
+    """A snapshot taken after bank growth crossed the uint8 wire-dtype
+    limit must restore with the widened dtype: otherwise bank ids above
+    the old sentinel narrow-cast into the wrong banks (e.g. 299 -> 43)
+    and bank 255 collides with the pad sentinel."""
+    import jax.numpy as jnp
+
+    from attendance_tpu.models.fused import bank_wire_dtype
+    from attendance_tpu.pipeline.events import encode_planar_batch
+
+    config = Config(bloom_filter_capacity=4_096,
+                    snapshot_dir=str(tmp_path / "snap"))
+    client = MemoryClient(MemoryBroker())
+    a = FusedPipeline(config, client=client, num_banks=8)
+    roster = np.arange(10_000, 12_000, dtype=np.uint32)
+    a.preload(roster)
+    # Register 300 distinct lecture days -> banks grow past 256 and the
+    # wire dtype must widen from uint8 to uint16.
+    n = 300
+    cols = {
+        "student_id": np.repeat(roster[:4], n)[:n].astype(np.uint32),
+        "lecture_day": (20260101 + np.arange(n)).astype(np.uint32),
+        "micros": np.full(n, 1_000_000, np.int64),
+        "is_valid": np.ones(n, bool),
+        "event_type": np.zeros(n, np.int8),
+    }
+    producer = client.create_producer(config.pulsar_topic)
+    producer.send(encode_planar_batch(cols))
+    a.run(max_events=n, idle_timeout_s=0.2)
+    assert a._bank_dtype is np.uint16
+    day = int(cols["lecture_day"][-1])  # bank index >= 256
+    count_before = a.count(day)
+    assert count_before >= 1
+    a.cleanup()
+
+    # Restart with the DEFAULT small bank count; restore must widen.
+    b = FusedPipeline(Config(bloom_filter_capacity=4_096,
+                             snapshot_dir=str(tmp_path / "snap")),
+                      client=MemoryClient(MemoryBroker()), num_banks=8)
+    assert b.state.hll_regs.shape[0] >= 300
+    assert b._bank_dtype is bank_wire_dtype(b.state.hll_regs.shape[0])
+    assert b._bank_dtype is np.uint16
+    assert b.count(day) == count_before
+    # New events for a high bank keep landing in the RIGHT bank.
+    producer2 = b.client.create_producer(b.config.pulsar_topic)
+    cols2 = dict(cols)
+    cols2["student_id"] = np.arange(10_000, 10_000 + n, dtype=np.uint32)
+    producer2.send(encode_planar_batch(cols2))
+    b.run(max_events=n, idle_timeout_s=0.2)
+    assert b.count(day) > count_before
+    b.cleanup()
