@@ -30,7 +30,9 @@ def test_pack_bits_transposed_layout():
     assert packed[15, 2] == np.uint32(1 << 31)    # word 15, bit 31
 
 
-@pytest.mark.parametrize("capacity", [1000, 5000])
+# 20_000 capacity -> ~431 blocks -> a 4-tile table, exercising the
+# tiled-gather path past the single native 128-lane tile.
+@pytest.mark.parametrize("capacity", [1000, 5000, 20_000])
 def test_bloom_kernel_matches_xla(capacity):
     params = derive_bloom_params(capacity, 0.01, "blocked")
     bits = bloom_init(params)
