@@ -87,6 +87,42 @@ def cmd_analyze(args) -> None:
         analyzer.cleanup()
 
 
+def cmd_fused(args) -> None:
+    """Hermetic flagship run: bulk binary loadgen -> FusedPipeline ->
+    columnar analyzer, all in-process (the north-star hot path end to
+    end; BASELINE.md bench config #5 at CLI scale)."""
+    from attendance_tpu.pipeline.analyzer import AttendanceAnalyzer
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+
+    config = config_from_args(args)
+    pipe = FusedPipeline(config)
+    try:
+        roster, frames = generate_frames(
+            args.num_events, args.frame_size,
+            roster_size=min(config.bloom_filter_capacity, args.num_events),
+            num_lectures=args.num_lectures, seed=args.seed or 0)
+        pipe.preload(roster)
+        producer = pipe.client.create_producer(config.pulsar_topic)
+        for frame in frames:
+            producer.send(frame)
+        pipe.run(max_events=args.num_events, idle_timeout_s=1.0)
+        m = pipe.metrics
+        counts = pipe.validity_counts()  # safe: last run is done
+        if counts is not None:
+            m.valid_events, m.invalid_events = counts
+        logger.info("Fused: %s",
+                    m.summary(pipe.estimated_fpr(),
+                              include_validity=counts is not None))
+        analyzer = AttendanceAnalyzer(pipe.store)
+        analyzer.print_insights(analyzer.generate_insights())
+        for day in pipe.lecture_days():
+            logger.info("LECTURE_%d: %d unique attendees", day,
+                        pipe.count(day))
+    finally:
+        pipe.cleanup()
+
+
 def cmd_pipeline(args) -> None:
     """Hermetic end-to-end run: generate -> process -> analyze in-process."""
     from attendance_tpu.pipeline.analyzer import AttendanceAnalyzer
@@ -164,6 +200,16 @@ def main(argv=None) -> None:
     add_flags(p_pipe)
     _add_generate_flags(p_pipe)
     p_pipe.set_defaults(fn=cmd_pipeline)
+
+    p_fast = sub.add_parser(
+        "fused", help="hermetic flagship run: bulk binary loadgen -> "
+        "fused device pipeline -> columnar analyzer")
+    add_flags(p_fast)
+    p_fast.add_argument("--num-events", type=int, default=1 << 20)
+    p_fast.add_argument("--frame-size", type=int, default=1 << 17)
+    p_fast.add_argument("--num-lectures", type=int, default=16)
+    p_fast.add_argument("--seed", type=int, default=0)
+    p_fast.set_defaults(fn=cmd_fused)
 
     p_par = sub.add_parser(
         "parity", help="differential tpu-vs-redis accuracy check "

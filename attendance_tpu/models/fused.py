@@ -28,9 +28,37 @@ class SketchState(NamedTuple):
     a 10M-student roster costs ~12MB of HBM, not the ~96MB a byte-per-bit
     array would — the memory budget that makes sketch sharding worthwhile
     at BASELINE.md bench config #4 scale.
+
+    ``counts`` accumulates (valid, invalid) real-lane totals on device —
+    a two-lane reduction folded into the step, so validity metrics cost
+    one readback after the last run instead of a per-frame device->host
+    sync. Each counter is 64-bit carried across two uint32 lanes
+    (TPU-native: no 64-bit integer path needed): row r = (lo, hi) of
+    counter r; per-step increments are < 2^32, so lo-wraparound detects
+    the carry exactly. Decode with :func:`decode_counts`.
     """
     bloom_bits: jax.Array  # uint32[m_bits // 32], bit-packed
     hll_regs: jax.Array    # uint8[num_banks, 2^p]
+    counts: jax.Array      # uint32[2, 2] = rows (valid, invalid), cols (lo, hi)
+
+
+def decode_counts(counts) -> Tuple[int, int]:
+    """(valid, invalid) Python ints from the two-lane uint32 counters."""
+    import numpy as np
+
+    a = np.asarray(counts, dtype=np.uint64)
+    return (int(a[0, 0] + (a[0, 1] << np.uint64(32))),
+            int(a[1, 0] + (a[1, 1] << np.uint64(32))))
+
+
+def _bump_counts(counts: jax.Array, nv: jax.Array,
+                 ni: jax.Array) -> jax.Array:
+    """Add (nv, ni) to the two-lane counters with carry propagation."""
+    lo, hi = counts[:, 0], counts[:, 1]
+    add = jnp.stack([nv, ni])
+    new_lo = lo + add
+    carry = (new_lo < lo).astype(jnp.uint32)  # add < 2^32: exact
+    return jnp.stack([new_lo, hi + carry], axis=1)
 
 
 def init_state(capacity: int = 100_000, error_rate: float = 0.01,
@@ -38,7 +66,8 @@ def init_state(capacity: int = 100_000, error_rate: float = 0.01,
                precision: int = 14) -> Tuple[SketchState, BloomParams]:
     params = derive_bloom_params(capacity, error_rate, layout)
     return SketchState(bloom_packed_init(params),
-                       hll_init(num_banks, precision)), params
+                       hll_init(num_banks, precision),
+                       jnp.zeros((2, 2), jnp.uint32)), params
 
 
 def fused_step(state: SketchState, keys: jax.Array, bank_idx: jax.Array,
@@ -59,7 +88,10 @@ def fused_step(state: SketchState, keys: jax.Array, bank_idx: jax.Array,
     regs = hll_add(state.hll_regs,
                    jnp.where(valid & mask, bank_idx, -1),
                    keys, precision=precision)
-    return SketchState(state.bloom_bits, regs), valid
+    nv = jnp.sum((valid & mask).astype(jnp.uint32))
+    nr = jnp.sum(mask.astype(jnp.uint32))
+    counts = _bump_counts(state.counts, nv, nr - nv)
+    return SketchState(state.bloom_bits, regs, counts), valid
 
 
 def make_jitted_step(params: BloomParams, precision: int = 14,
@@ -122,7 +154,11 @@ def fused_step_bytes(state: SketchState, buf: jax.Array,
     regs = hll_add(state.hll_regs,
                    jnp.where(valid, bank_idx, -1),
                    keys, precision=precision)
-    return SketchState(state.bloom_bits, regs), valid
+    real = bank_idx >= 0
+    nv = jnp.sum((valid & real).astype(jnp.uint32))
+    nr = jnp.sum(real.astype(jnp.uint32))
+    counts = _bump_counts(state.counts, nv, nr - nv)
+    return SketchState(state.bloom_bits, regs, counts), valid
 
 
 def make_jitted_step_bytes(params: BloomParams, bank_itemsize: int,

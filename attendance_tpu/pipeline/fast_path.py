@@ -316,9 +316,11 @@ class FusedPipeline:
         self._snap_dir.mkdir(parents=True, exist_ok=True)
         if self.sharded:
             bits, regs = self.engine.get_state()
+            counts = np.zeros((2, 2), np.uint32)
         else:
             bits = np.asarray(self.state.bloom_bits)
             regs = np.asarray(self.state.hll_regs)
+            counts = np.asarray(self.state.counts)
         manifest = {
             "bank_of": {str(d): b for d, b in self._bank_of.items()},
             "m_bits": self.params.m_bits,
@@ -330,7 +332,7 @@ class FusedPipeline:
         tmp = path.with_suffix(".tmp")
         with open(tmp, "wb") as f:
             np.savez_compressed(
-                f, bloom_words=bits, hll_regs=regs,
+                f, bloom_words=bits, hll_regs=regs, counts=counts,
                 manifest=np.frombuffer(
                     json.dumps(manifest).encode(), dtype=np.uint8))
         tmp.replace(path)
@@ -358,12 +360,15 @@ class FusedPipeline:
                     "register banks are not convertible across precisions")
             bits = data["bloom_words"]
             regs = data["hll_regs"]
+            counts = (data["counts"] if "counts" in data
+                      else np.zeros((2, 2), np.uint32))
         if self.sharded:
             self.engine.set_state(bits, regs)
         else:
             self.state = self.state._replace(
                 bloom_bits=jax.numpy.asarray(bits),
-                hll_regs=jax.numpy.asarray(regs))
+                hll_regs=jax.numpy.asarray(regs),
+                counts=jax.numpy.asarray(counts))
             # The snapshot may hold more banks than this construction
             # (growth before the crash): re-derive the wire dtype and
             # step program from the RESTORED bank count, or bank ids
@@ -436,12 +441,16 @@ class FusedPipeline:
             self._checkpoint_and_ack()
         self._drain_inflight(block=-1)
         self.metrics.wall_seconds = time.perf_counter() - t_start
+        # NO device->host reads here: on this platform a single D2H of
+        # the donated-chain state (even 8 bytes of counters) permanently
+        # collapses async dispatch throughput ~50x for the rest of the
+        # process. Validity totals live on device (state.counts) and are
+        # fetched on demand via validity_counts(); the FPR estimate is
+        # likewise deferred to callers that want it after their last
+        # run. The metrics line defers both.
         if logger.isEnabledFor(logging.INFO):
-            # Validity is an async device side-output here (it lands in
-            # the columnar store, not in host counters), so the line
-            # reports it as deferred rather than a misleading 0/0.
             logger.info("Fused metrics: %s",
-                        self.metrics.summary(self.estimated_fpr(),
+                        self.metrics.summary(None,
                                              include_validity=False))
 
     def _run_loop(self, max_events: Optional[int],
@@ -486,6 +495,23 @@ class FusedPipeline:
                 break
 
     # -- queries ------------------------------------------------------------
+    def lecture_days(self):
+        """Sorted lecture days with an HLL bank (the countable keys)."""
+        return sorted(self._bank_of)
+
+    def validity_counts(self) -> Optional[tuple]:
+        """(valid, invalid) totals accumulated on device since
+        construction; None on the sharded engine (no accumulators).
+
+        Forces a device sync AND (platform caveat) a D2H read that can
+        permanently degrade async dispatch on relay-tunneled devices —
+        call it after the LAST run of the process, never mid-stream.
+        """
+        if self.sharded:
+            return None
+        from attendance_tpu.models.fused import decode_counts
+        return decode_counts(self.state.counts)
+
     def estimated_fpr(self) -> float:
         """Occupancy-based FPR estimate of the roster filter: fill^k
         (slight underestimate for the blocked layout, whose per-block

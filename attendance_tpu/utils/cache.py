@@ -1,0 +1,25 @@
+"""Shared persistent XLA compilation-cache setup.
+
+First compiles on this platform cost tens of seconds to minutes; the
+on-disk cache makes repeats near-instant. Used by every standalone entry
+point that compiles device programs (bench.py, __graft_entry__.py).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+
+def enable_compilation_cache(root: str) -> None:
+    """Point JAX's persistent compilation cache at <root>/.jax_cache.
+
+    Best-effort: the cache is an optimization, never a requirement.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          str(Path(root) / ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass

@@ -43,12 +43,9 @@ TARGET_CHIPS = 8
 
 
 def _enable_compilation_cache() -> None:
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          str(Path(__file__).resolve().parent / ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass  # cache is an optimization, never a requirement
+    from attendance_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache(str(Path(__file__).resolve().parent))
 
 
 def bench_fused_step(batch_size: int, seconds: float, capacity: int,

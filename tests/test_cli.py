@@ -15,3 +15,11 @@ def test_pipeline_subcommand_memory_backend(capsys):
 def test_analyze_subcommand_empty(capsys):
     main(["analyze", "--sketch-backend", "memory"])
     assert "No insights available" in capsys.readouterr().out
+
+
+def test_fused_subcommand(capsys):
+    main(["fused", "--num-events", "16384", "--frame-size", "4096",
+          "--num-lectures", "4", "--bloom-capacity", "20000"])
+    out = capsys.readouterr().out
+    assert "Habitual Latecomers" in out
+    assert "Invalid Attendance Attempts" in out

@@ -117,3 +117,45 @@ def test_processor_metrics_line_logged(caplog):
     metrics_lines = [r.getMessage() for r in caplog.records
                      if "est. bloom FPR" in r.getMessage()]
     assert metrics_lines
+
+
+def test_device_validity_counters_carry_past_32_bits():
+    """The (lo, hi) two-lane counters must carry exactly when lo wraps —
+    the 64-bit contract TPUs can't express with a native int64."""
+    import jax.numpy as jnp
+
+    from attendance_tpu.models.fused import _bump_counts, decode_counts
+
+    near = np.uint32(0xFFFFFFFF - 5)
+    counts = jnp.asarray(np.array([[near, 0], [near, 3]], np.uint32))
+    counts = _bump_counts(counts, jnp.uint32(10), jnp.uint32(2))
+    v, i = decode_counts(counts)
+    assert v == int(near) + 10  # crossed 2^32: hi lane carried
+    assert i == (3 << 32) + int(near) + 2
+
+
+def test_validity_counts_survive_snapshot_restore(tmp_path):
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(bloom_filter_capacity=5_000,
+                    snapshot_dir=str(tmp_path / "snap"))
+    a = FusedPipeline(config, client=MemoryClient(MemoryBroker()),
+                      num_banks=8)
+    roster, frames = generate_frames(4_096, 2_048, roster_size=5_000,
+                                     num_lectures=4)
+    a.preload(roster)
+    producer = a.client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    a.run(idle_timeout_s=0.2)
+    before = a.validity_counts()
+    assert sum(before) == 4_096
+    a.cleanup()
+
+    b = FusedPipeline(Config(bloom_filter_capacity=5_000,
+                             snapshot_dir=str(tmp_path / "snap")),
+                      client=MemoryClient(MemoryBroker()), num_banks=8)
+    assert b.validity_counts() == before
