@@ -271,6 +271,33 @@ def bloom_add_packed(words: jax.Array, keys: jax.Array, params: BloomParams,
     return packed_or_scatter(words, pos.reshape(-1), m_words)
 
 
+# Roster preload runs in fixed-shape chunks: XLA compiles the scatter
+# once (compile time grows superlinearly with update count on TPU; a
+# 1M-key single-shot scatter costs minutes of compile where 2^14-key
+# chunks cost seconds) and every further chunk reuses it.
+PRELOAD_CHUNK = 1 << 14
+
+
+def chunked_preload(preload_fn, bits, keys, chunk: int = PRELOAD_CHUNK):
+    """Feed keys through a jitted single-chunk Bloom add in fixed-shape
+    chunks, padding the tail with a repeat of the first key (Bloom add
+    is idempotent). ``preload_fn(bits, chunk)`` is the compiled add;
+    shared by the fused pipeline, the sharded engine, and the benchmark
+    rig so all preload through one compiled regime. Callers with a
+    sharded batch axis pass a ``chunk`` rounded to their axis size."""
+    import numpy as np
+
+    keys = np.asarray(keys, dtype=np.uint32)
+    if len(keys) == 0:
+        return bits
+    pad = (-len(keys)) % chunk
+    if pad:
+        keys = np.concatenate([keys, np.full(pad, keys[0], np.uint32)])
+    for i in range(0, len(keys), chunk):
+        bits = preload_fn(bits, jnp.asarray(keys[i:i + chunk]))
+    return bits
+
+
 def bloom_contains_words(words: jax.Array, keys: jax.Array,
                          params: BloomParams) -> jax.Array:
     """Membership test against a packed filter: bool[B].

@@ -215,13 +215,23 @@ class ShardedSketchEngine:
 
     # -- public API ----------------------------------------------------------
     def preload(self, keys) -> None:
-        """Batched BF.ADD of the roster into the sharded filter."""
-        keys = np.asarray(keys, dtype=np.uint32)
-        kbuf, n = self._pad(keys, 0, np.uint32)
-        mask = np.zeros(len(kbuf), dtype=bool)
-        mask[:n] = True
-        self.bits = self._preload(self.bits, jnp.asarray(kbuf),
-                                  jnp.asarray(mask))
+        """Batched BF.ADD of the roster into the sharded filter.
+
+        Chunked at a fixed shape (models.bloom.chunked_preload) so a
+        10M-key roster reuses ONE compiled scatter instead of compiling
+        a roster-sized one; pad lanes repeat a real key (idempotent), so
+        the all-True mask is correct."""
+        from attendance_tpu.models.bloom import (
+            PRELOAD_CHUNK, chunked_preload)
+
+        # Chunk rounded up to a dp multiple so the batch axis splits
+        # evenly across replicas on any mesh (e.g. dp=3 on 6 devices).
+        dp = self.mesh.shape["dp"]
+        chunk = ((PRELOAD_CHUNK + dp - 1) // dp) * dp
+        mask = jnp.ones(chunk, bool)
+        self.bits = chunked_preload(
+            lambda bits, c: self._preload(bits, c, mask),
+            self.bits, keys, chunk=chunk)
 
     def step(self, keys, bank_idx) -> jax.Array:
         """Fused validate+count for one micro-batch; returns validity[B].

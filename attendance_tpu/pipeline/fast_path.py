@@ -70,27 +70,10 @@ logger = logging.getLogger(__name__)
 
 _INFLIGHT_DEPTH = 8  # dispatched-but-unacked batches before forcing a sync
 DEFAULT_SNAPSHOT_EVERY = 64  # barrier cadence when only snapshot_dir is set
-# Roster preload runs in fixed-shape chunks: XLA compiles the scatter once
-# (compile time grows superlinearly with update count on TPU; a 1M-key
-# single-shot scatter costs minutes of compile where 2^14-key chunks cost
-# seconds) and every further chunk reuses it.
-PRELOAD_CHUNK = 1 << 14
-
-
-def chunked_preload(preload_fn, bits, keys: np.ndarray):
-    """Feed keys through a jitted single-chunk Bloom add in fixed-shape
-    chunks of PRELOAD_CHUNK, padding the tail with a repeat of the first
-    key (Bloom add is idempotent). Shared by FusedPipeline.preload and
-    the benchmark rig so both measure the same preload regime."""
-    keys = np.asarray(keys, dtype=np.uint32)
-    if len(keys) == 0:
-        return bits
-    pad = (-len(keys)) % PRELOAD_CHUNK
-    if pad:
-        keys = np.concatenate([keys, np.full(pad, keys[0], np.uint32)])
-    for i in range(0, len(keys), PRELOAD_CHUNK):
-        bits = preload_fn(bits, jax.numpy.asarray(keys[i:i + PRELOAD_CHUNK]))
-    return bits
+# Canonical chunked-preload helper lives next to the scatter it feeds
+# (models.bloom); re-exported here for the pipeline's callers (bench).
+from attendance_tpu.models.bloom import (  # noqa: E402,F401
+    PRELOAD_CHUNK, chunked_preload)
 
 SKETCH_SNAPSHOT = "fused_sketch.npz"
 EVENTS_SNAPSHOT = "fused_events.npz"

@@ -16,7 +16,9 @@ from attendance_tpu.parallel.sharded import ShardedSketchEngine, make_mesh
 # Kept deliberately small: every (mesh shape, layout) pair compiles its
 # own shard_map programs, and XLA:CPU compiles of the scatter kernels run
 # tens of seconds before the persistent cache warms.
-MESH_SHAPES = [(1, 8), (2, 4)]
+# (3, 2): dp does not divide the preload chunk or power-of-two batch
+# sizes — regression shape for the dp-rounded chunked preload.
+MESH_SHAPES = [(1, 8), (2, 4), (3, 2)]
 
 
 def engine(dp, sp, **kw):
