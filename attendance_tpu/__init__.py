@@ -25,3 +25,32 @@ Layering (mirrors SURVEY.md §1, rebuilt TPU-native):
 __version__ = "0.1.0"
 
 from attendance_tpu.config import Config, DEFAULT_CONFIG  # noqa: F401
+
+# Lazy top-level exports: `from attendance_tpu import FusedPipeline`
+# without paying the jax import at package-import time.
+_EXPORTS = {
+    "AttendanceProcessor": "attendance_tpu.pipeline.processor",
+    "FusedPipeline": "attendance_tpu.pipeline.fast_path",
+    "AttendanceAnalyzer": "attendance_tpu.pipeline.analyzer",
+    "generate_student_data": "attendance_tpu.pipeline.generator",
+    "make_sketch_store": "attendance_tpu.sketch",
+    "make_event_store": "attendance_tpu.storage",
+    "make_client": "attendance_tpu.transport",
+    "ShardedSketchEngine": "attendance_tpu.parallel.sharded",
+    "run_parity": "attendance_tpu.parity",
+    "run_redis_parity": "attendance_tpu.parity",
+}
+
+
+def __getattr__(name):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module 'attendance_tpu' has no "
+                             f"attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_EXPORTS))

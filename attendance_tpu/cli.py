@@ -79,6 +79,23 @@ def cmd_analyze(args) -> None:
     config = config_from_args(args)
     store = make_event_store(config)
     if args.events_file:
+        # Sniff the format: the fused pipeline's columnar snapshots are
+        # npz (zip magic); the row stores save JSONL. Swap to the store
+        # that can actually read the file when the flag disagrees.
+        with open(args.events_file, "rb") as f:
+            is_npz = f.read(2) == b"PK"
+        is_columnar = hasattr(store, "insert_columns")
+        if is_npz and not is_columnar:
+            from attendance_tpu.storage.columnar_store import (
+                ColumnarEventStore)
+            logger.info("events file is columnar npz; using the "
+                        "columnar store")
+            store = ColumnarEventStore()
+        elif not is_npz and is_columnar:
+            from attendance_tpu.storage.memory_store import (
+                MemoryEventStore)
+            logger.info("events file is row JSONL; using the row store")
+            store = MemoryEventStore()
         store.load(args.events_file)
     analyzer = AttendanceAnalyzer(store)
     try:
@@ -147,9 +164,11 @@ def cmd_pipeline(args) -> None:
     analyzer.print_insights(analyzer.generate_insights())
     for lecture_id in processor.store.distinct_lecture_ids():
         stats = processor.get_attendance_stats(lecture_id)
+        records = stats["attendance_records"]
+        num = (len(records["student_id"]) if isinstance(records, dict)
+               else len(records))  # columnar scan returns column dicts
         logger.info("%s: %d unique attendees, %d records", lecture_id,
-                    stats["unique_attendees"],
-                    len(stats["attendance_records"]))
+                    stats["unique_attendees"], num)
     processor.cleanup()
 
 

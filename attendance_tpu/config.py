@@ -120,7 +120,8 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    help="execution backend for BF.*/PFADD/PFCOUNT")
     p.add_argument("--transport-backend", choices=["memory", "pulsar"],
                    default=d.transport_backend)
-    p.add_argument("--storage-backend", choices=["memory", "cassandra"],
+    p.add_argument("--storage-backend",
+                   choices=["memory", "columnar", "cassandra"],
                    default=d.storage_backend)
     p.add_argument("--pulsar-host", default=d.pulsar_host)
     p.add_argument("--pulsar-topic", default=d.pulsar_topic)

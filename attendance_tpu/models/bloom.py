@@ -285,8 +285,6 @@ def chunked_preload(preload_fn, bits, keys, chunk: int = PRELOAD_CHUNK):
     shared by the fused pipeline, the sharded engine, and the benchmark
     rig so all preload through one compiled regime. Callers with a
     sharded batch axis pass a ``chunk`` rounded to their axis size."""
-    import numpy as np
-
     keys = np.asarray(keys, dtype=np.uint32)
     if len(keys) == 0:
         return bits

@@ -38,8 +38,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from attendance_tpu.models.bloom import (
-    BLOCK_BITS, BloomParams, bloom_positions, derive_bloom_params,
-    packed_or_scatter)
+    BLOCK_BITS, PRELOAD_CHUNK, BloomParams, bloom_positions,
+    chunked_preload, derive_bloom_params, packed_or_scatter)
 from attendance_tpu.models.hll import (
     estimate_from_histogram, hll_bucket_rank)
 
@@ -221,9 +221,6 @@ class ShardedSketchEngine:
         10M-key roster reuses ONE compiled scatter instead of compiling
         a roster-sized one; pad lanes repeat a real key (idempotent), so
         the all-True mask is correct."""
-        from attendance_tpu.models.bloom import (
-            PRELOAD_CHUNK, chunked_preload)
-
         # Chunk rounded up to a dp multiple so the batch axis splits
         # evenly across replicas on any mesh (e.g. dp=3 on 6 devices).
         dp = self.mesh.shape["dp"]

@@ -510,6 +510,18 @@ class FusedPipeline:
             fill = float(bloom_packed_fill_fraction(self.state.bloom_bits))
         return fill ** self.params.k
 
+    def get_attendance_stats(self, lecture_day: int) -> Dict:
+        """PFCOUNT + partition scan for one lecture day — the fused-path
+        analogue of the reference's stats query (reference
+        attendance_processor.py:149-165): HLL unique attendees plus the
+        stored records of that partition."""
+        records = self.store.scan_lecture(int(lecture_day))
+        return {
+            "unique_attendees": self.count(int(lecture_day)),
+            "attendance_records": records,
+            "num_records": len(records["student_id"]),
+        }
+
     def count(self, lecture_day: int) -> int:
         bank = self._bank_of.get(int(lecture_day))
         if bank is None:

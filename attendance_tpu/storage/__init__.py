@@ -23,6 +23,9 @@ def make_event_store(config):
     """Build the event store selected by config.storage_backend."""
     if config.storage_backend == "memory":
         return MemoryEventStore()
+    if config.storage_backend == "columnar":
+        from attendance_tpu.storage.columnar_store import ColumnarEventStore
+        return ColumnarEventStore()
     if config.storage_backend == "cassandra":
         from attendance_tpu.storage.cassandra_store import CassandraEventStore
         return CassandraEventStore(config)

@@ -88,6 +88,37 @@ class ColumnarEventStore:
         days = self.to_columns(deduplicate=False)["lecture_day"]
         return np.unique(np.asarray(days, np.int64)).tolist()
 
+    def scan_lecture(self, lecture_day) -> Dict[str, np.ndarray]:
+        """One lecture partition's (deduped) columns — the columnar
+        equivalent of the reference's per-lecture partition scan
+        (reference attendance_processor.py:155-160,
+        attendance_analysis.py:32-39). Accepts an integer day code or a
+        reference-style ``LECTURE_YYYYMMDD`` string id."""
+        if isinstance(lecture_day, str):
+            from attendance_tpu.pipeline.events import _lecture_to_day
+            lecture_day = _lecture_to_day(lecture_day)
+        cols = self.to_columns()
+        sel = np.asarray(cols["lecture_day"], np.int64) == int(lecture_day)
+        return {name: np.asarray(arr)[sel] for name, arr in cols.items()}
+
+    # -- row-store interface adapters ---------------------------------------
+    # The generic processor and CLI speak the row-store vocabulary
+    # (insert_batch of AttendanceRow, string lecture ids); these adapters
+    # make --storage-backend=columnar a drop-in there too.
+    def insert_batch(self, rows) -> int:
+        """Append AttendanceRow-shaped objects as one column block."""
+        from attendance_tpu.pipeline.events import columns_from_events
+        if not rows:
+            return 0
+        return self.insert_columns(columns_from_events(rows))
+
+    def insert(self, row) -> None:
+        self.insert_batch([row])
+
+    def distinct_lecture_ids(self) -> List[str]:
+        """Reference-style lecture ids for the stored day codes."""
+        return [f"LECTURE_{day}" for day in self.distinct_lecture_days()]
+
     # -- durability ----------------------------------------------------------
     def save(self, path) -> None:
         path = Path(path)

@@ -23,3 +23,48 @@ def test_fused_subcommand(capsys):
     out = capsys.readouterr().out
     assert "Habitual Latecomers" in out
     assert "Invalid Attendance Attempts" in out
+
+
+def test_analyze_loads_columnar_events_file(tmp_path, capsys):
+    """analyze --events-file must accept the fused pipeline's columnar
+    npz snapshot, not just the row stores' JSONL format."""
+    main(["fused", "--num-events", "8192", "--frame-size", "2048",
+          "--num-lectures", "4", "--bloom-capacity", "20000",
+          "--snapshot-dir", str(tmp_path)])
+    capsys.readouterr()
+    main(["analyze", "--events-file", str(tmp_path / "fused_events.npz")])
+    out = capsys.readouterr().out
+    assert "Habitual Latecomers" in out
+    assert "Invalid Attendance Attempts" in out
+
+
+def test_pipeline_subcommand_columnar_backend(capsys):
+    """--storage-backend columnar must be a drop-in for the generic
+    processor path (row-store vocabulary adapted on the columnar
+    store)."""
+    main(["pipeline", "--sketch-backend", "memory",
+          "--storage-backend", "columnar", "--num-students", "40",
+          "--num-invalid", "5", "--seed", "1", "--batch-size", "128",
+          "--batch-timeout-s", "0.01"])
+    out = capsys.readouterr().out
+    assert "Habitual Latecomers" in out
+
+
+def test_analyze_loads_jsonl_into_columnar_flag(tmp_path, capsys):
+    """analyze --storage-backend columnar with a row-store JSONL file
+    must swap to the row store instead of crashing on np.load."""
+    from attendance_tpu.pipeline.generator import generate_student_data
+    from attendance_tpu.storage.memory_store import (
+        AttendanceRow, MemoryEventStore)
+
+    report = generate_student_data(num_students=30, num_invalid=3, seed=5)
+    store = MemoryEventStore()
+    store.insert_batch([
+        AttendanceRow(e.student_id, e.timestamp, e.lecture_id,
+                      e.is_valid, e.event_type) for e in report.events])
+    path = tmp_path / "events.jsonl"
+    store.save(path)
+    main(["analyze", "--storage-backend", "columnar",
+          "--events-file", str(path)])
+    out = capsys.readouterr().out
+    assert "Habitual Latecomers" in out

@@ -142,3 +142,38 @@ def test_analyzer_reads_columnar_store():
     insights = AttendanceAnalyzer(store).generate_insights()
     assert [i["title"] for i in insights][0] == "Habitual Latecomers"
     assert insights[2]["data"]["most_attended"]
+
+
+def test_fused_get_attendance_stats():
+    """Reference get_attendance_stats contract on the fused path
+    (reference attendance_processor.py:149-165): HLL unique count +
+    that lecture partition's stored records."""
+    import numpy as np
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(bloom_filter_capacity=5_000)
+    pipe = FusedPipeline(config, client=MemoryClient(MemoryBroker()),
+                         num_banks=8)
+    roster, frames = generate_frames(8_192, 2_048, roster_size=5_000,
+                                     num_lectures=3, seed=11)
+    pipe.preload(roster)
+    producer = pipe.client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(idle_timeout_s=0.2)
+
+    day = pipe.lecture_days()[0]
+    stats = pipe.get_attendance_stats(day)
+    recs = stats["attendance_records"]
+    assert stats["num_records"] == len(recs["student_id"]) > 0
+    assert (np.asarray(recs["lecture_day"], np.int64) == day).all()
+    valid = np.asarray(recs["is_valid"]).astype(bool)
+    exact = len(np.unique(np.asarray(recs["student_id"])[valid]))
+    # HLL estimate within its error budget of the exact distinct count.
+    assert abs(stats["unique_attendees"] - exact) <= max(3, 0.05 * exact)
+    pipe.cleanup()
