@@ -1,0 +1,126 @@
+"""ctypes binding for the native host runtime (hostpipe.c).
+
+``load()`` returns a :class:`HostPipe` wrapping the compiled shared
+library, or ``None`` when the library can't be built/loaded — callers
+(pipeline.fast_path) keep a numpy fallback, so the framework is fully
+functional without a C toolchain; with one, the ingress host path runs
+as a single fused native pass (see hostpipe.c for why).
+
+Set ``ATP_NATIVE=0`` to force the numpy path (used by the differential
+tests that assert native == numpy behavior).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_cached: Optional["HostPipe"] = None
+_tried = False
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+
+
+def _ptr(arr: np.ndarray, typ):
+    return arr.ctypes.data_as(typ)
+
+
+class HostPipe:
+    """Typed wrapper over the hostpipe shared library."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.atp_max_key.restype = ctypes.c_uint32
+        lib.atp_max_key.argtypes = [_u8p, ctypes.c_size_t, ctypes.c_size_t]
+        lib.atp_pack_words.restype = ctypes.c_int64
+        lib.atp_pack_words.argtypes = [
+            _u8p, ctypes.c_size_t, _u8p, ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_size_t,
+            _i32p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+            _u32p]
+        lib.atp_pack_bytes.restype = ctypes.c_int64
+        lib.atp_pack_bytes.argtypes = [
+            _u8p, ctypes.c_size_t, _u8p, ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_size_t,
+            _i32p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+            _u8p]
+
+    # -- column access helpers ----------------------------------------------
+    @staticmethod
+    def _strided(col: np.ndarray):
+        """(byte pointer, element stride) for a u32 column — works for
+        contiguous planar views and strided ATB1 record fields alike.
+        The caller's reference keeps the owning buffer alive for the
+        duration of the (synchronous) native call."""
+        addr = col.__array_interface__["data"][0]
+        return ctypes.cast(addr, _u8p), col.strides[0]
+
+    def max_key(self, keys: np.ndarray) -> int:
+        base, stride = self._strided(keys)
+        return int(self._lib.atp_max_key(base, len(keys), stride))
+
+    def pack_words(self, keys: np.ndarray, days: np.ndarray,
+                   lut: np.ndarray, day_base: int, kw: int,
+                   padded: int) -> Tuple[Optional[np.ndarray], int]:
+        """Fused LUT map + word pack. Returns (words, -1) on success or
+        (None, miss_index) when a day had no registered bank."""
+        kb, ks = self._strided(keys)
+        db, ds = self._strided(days)
+        out = np.empty(padded, np.uint32)
+        rc = self._lib.atp_pack_words(
+            kb, ks, db, ds,
+            len(keys), padded, _ptr(lut, _i32p),
+            ctypes.c_uint32(day_base & 0xFFFFFFFF), len(lut), kw,
+            _ptr(out, _u32p))
+        if rc == 0:
+            return out, -1
+        return None, int(rc - 1)
+
+    def pack_bytes(self, keys: np.ndarray, days: np.ndarray,
+                   lut: np.ndarray, day_base: int, bank_width: int,
+                   padded: int) -> Tuple[Optional[np.ndarray], int]:
+        """Fused LUT map + byte pack (5-byte fallback wire)."""
+        kb, ks = self._strided(keys)
+        db, ds = self._strided(days)
+        out = np.empty((4 + bank_width) * padded, np.uint8)
+        rc = self._lib.atp_pack_bytes(
+            kb, ks, db, ds,
+            len(keys), padded, _ptr(lut, _i32p),
+            ctypes.c_uint32(day_base & 0xFFFFFFFF), len(lut), bank_width,
+            _ptr(out, _u8p))
+        if rc == 0:
+            return out, -1
+        return None, int(rc - 1)
+
+
+def load() -> Optional[HostPipe]:
+    """Build (if needed) and load the native host runtime; None if the
+    toolchain is unavailable or ATP_NATIVE=0."""
+    global _cached, _tried
+    if os.environ.get("ATP_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _tried:
+            return _cached
+        _tried = True
+        from attendance_tpu.native.build import build
+        path = build()
+        if path is None:
+            return None
+        try:
+            _cached = HostPipe(ctypes.CDLL(str(path)))
+            logger.info("native hostpipe loaded: %s", path.name)
+        except OSError as exc:
+            logger.warning("native hostpipe load failed: %s", exc)
+            _cached = None
+        return _cached

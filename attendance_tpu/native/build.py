@@ -1,0 +1,102 @@
+"""Build + cache the native host-runtime shared library.
+
+Compiles ``hostpipe.c`` with the system C compiler on first use and
+caches the resulting ``_hostpipe-<hash>.so`` next to this module (or in
+``$ATP_NATIVE_CACHE`` when the package directory is read-only).  The
+hash covers the source bytes, so editing the C file rebuilds
+automatically and stale caches are never loaded.
+
+No pip/pybind dependencies: plain ctypes against a ``-shared -fPIC``
+object (the environment bakes in gcc/g++ but not pybind11).  Build
+failure of any kind is non-fatal — callers fall back to the numpy host
+path (see native/__init__.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import shutil
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_SRC = Path(__file__).resolve().parent / "hostpipe.c"
+
+
+def _cache_dir() -> Path:
+    env = os.environ.get("ATP_NATIVE_CACHE")
+    if env:
+        return Path(env)
+    return _SRC.parent
+
+
+def _compiler() -> Optional[str]:
+    for cc in (os.environ.get("CC"), "cc", "gcc", "clang"):
+        if cc and shutil.which(cc):
+            return cc
+    return None
+
+
+def build(force: bool = False) -> Optional[Path]:
+    """Return the path of the built shared library, or None.
+
+    The build is atomic (compile to a temp file, rename into place) so
+    concurrent test workers never load a half-written object.
+    """
+    try:
+        src = _SRC.read_bytes()
+    except OSError:
+        return None
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = _cache_dir() / f"_hostpipe-{tag}.so"
+    if out.exists() and not force:
+        return out
+    cc = _compiler()
+    if cc is None:
+        logger.info("native hostpipe: no C compiler found; using numpy")
+        return None
+    try:
+        # A read-only package dir (system/Nix installs) must degrade to
+        # the numpy path, not crash — keep every fs touch in the try.
+        out.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(out.parent))
+        os.close(fd)
+    except OSError as exc:
+        logger.info("native hostpipe: cache dir not writable (%s); "
+                    "using numpy (set ATP_NATIVE_CACHE to override)", exc)
+        return None
+    cmd = [cc, "-O3", "-march=native", "-std=c17", "-shared", "-fPIC",
+           "-o", tmp, str(_SRC)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            # -march=native can be unsupported on exotic hosts; retry
+            # portable before giving up.
+            cmd.remove("-march=native")
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=120)
+        if proc.returncode != 0:
+            logger.warning("native hostpipe build failed (%s); "
+                           "using numpy:\n%s", cc, proc.stderr[-2000:])
+            os.unlink(tmp)
+            return None
+        os.replace(tmp, out)
+        return out
+    except Exception as exc:  # toolchain/fs oddities: never fatal
+        logger.warning("native hostpipe build error: %s; using numpy", exc)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+if __name__ == "__main__":
+    path = build(force=True)
+    print(path if path else "BUILD FAILED")

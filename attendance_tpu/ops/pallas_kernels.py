@@ -180,14 +180,14 @@ def _bloom_contains_call(packed, keys2d, *, num_blocks: int, k: int):
         grid=(rows // _SUBLANES,),
         in_specs=[
             pl.BlockSpec(packed.shape, lambda i: (0, 0),
-                         memory_space=pltpu.ANY
+                         memory_space=pl.ANY
                          if _on_cpu() else pltpu.VMEM),
             pl.BlockSpec((_SUBLANES, width), lambda i: (i, 0),
-                         memory_space=pltpu.ANY
+                         memory_space=pl.ANY
                          if _on_cpu() else pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((_SUBLANES, width), lambda i: (i, 0),
-                               memory_space=pltpu.ANY
+                               memory_space=pl.ANY
                                if _on_cpu() else pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(keys2d.shape, jnp.uint8),
         interpret=_on_cpu(),
@@ -248,9 +248,9 @@ def _hist_call(regs, *, num_values: int):
     kern = functools.partial(_hist_kernel, num_values=num_values)
     return pl.pallas_call(
         kern,
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY
                                if _on_cpu() else pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY
+        out_specs=pl.BlockSpec(memory_space=pl.ANY
                                if _on_cpu() else pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((num_banks, num_values), jnp.int32),
         interpret=_on_cpu(),

@@ -99,16 +99,28 @@ def _iso_to_micros(ts: str) -> int:
     return int(dt.timestamp() * 1e6)
 
 
+_HASH_DAY_BASE = 100_000_000           # above any yyyymmdd calendar value
+_HASH_DAY_LIMIT = _HASH_DAY_BASE + (1 << 26)
+
+
 def _lecture_to_day(lecture_id: str) -> int:
     # "LECTURE_YYYYMMDD" -> yyyymmdd; non-conforming ids hash to a stable
     # bucket above any calendar value so they stay distinct from real
     # days. murmur3 (not builtin hash) so the mapping survives process
     # restarts — PYTHONHASHSEED salts str hashes per interpreter.
     tail = lecture_id.rsplit("_", 1)[-1]
-    if tail.isdigit() and len(tail) == 8:
-        return int(tail)
+    if tail.isdigit():
+        if len(tail) == 8:
+            return int(tail)
+        # Round-trip of an already-hashed code: stores re-emit hashed
+        # days as "LECTURE_<9-digit-code>" (columnar_store
+        # .distinct_lecture_ids); parsing that back must return the
+        # code itself, not hash the synthetic string to a new bucket.
+        if len(tail) == 9 and _HASH_DAY_BASE <= int(tail) < _HASH_DAY_LIMIT:
+            return int(tail)
     from attendance_tpu.ops.murmur3 import murmur3_bytes
-    return 100_000_000 + (murmur3_bytes(lecture_id.encode(), 0) & 0x3FFFFFF)
+    return _HASH_DAY_BASE + (murmur3_bytes(lecture_id.encode(), 0)
+                             & 0x3FFFFFF)
 
 
 def encode_event_binary(event: AttendanceEvent) -> bytes:

@@ -56,7 +56,8 @@ import numpy as np
 from attendance_tpu.config import Config
 from attendance_tpu.models.bloom import bloom_add_packed
 from attendance_tpu.models.fused import (
-    bank_wire_dtype, init_state, make_jitted_step_bytes)
+    bank_wire_dtype, init_state, make_jitted_step_bytes,
+    make_jitted_step_words, pack_words)
 from attendance_tpu.models.hll import (
     best_histogram, estimate_from_histogram)
 from attendance_tpu.pipeline.events import decode_binary_batch
@@ -119,6 +120,19 @@ class FusedPipeline:
             self._step = make_jitted_step_bytes(
                 self.params, np.dtype(self._bank_dtype).itemsize,
                 self.config.hll_precision)
+            # Word-packed (4-byte/event) step programs, one per key
+            # width; _kw_hint grows monotonically so a stable key
+            # population compiles at most a couple of widths.
+            self._word_steps: Dict[int, object] = {}
+            self._kw_hint = 1
+            # Native host runtime (fused decode+LUT+pack pass); None
+            # falls back to the numpy path transparently. _native_skip
+            # adaptively bypasses doomed native attempts when the
+            # stream steadily contains days the dense LUT cannot cover
+            # (see _dispatch_single).
+            from attendance_tpu.native import load as load_native
+            self._native = load_native()
+            self._native_skip = 0
             self._preload = jax.jit(
                 lambda bits, keys: bloom_add_packed(bits, keys,
                                                     self.params),
@@ -257,28 +271,16 @@ class FusedPipeline:
         n = len(cols["student_id"])
         if n == 0:
             return None
-        banks = self._banks_for(cols["lecture_day"])
         if self.sharded:
+            banks = self._banks_for(cols["lecture_day"])
             with maybe_annotate(self._profiling, "sharded_fused_step"):
                 valid_n = self.engine.step(cols["student_id"], banks)
         else:
             padded = 256
             while padded < n:
                 padded *= 2
-            # ONE combined byte-packed transfer: B little-endian uint32
-            # keys then B narrow bank ids (dtype max = padded lane) —
-            # (4 + w) bytes/event on the host->device link instead of 8.
-            w = np.dtype(self._bank_dtype).itemsize
-            buf = np.empty((4 + w) * padded, np.uint8)
-            kv = buf[:4 * padded].view(np.uint32)
-            kv[:n] = cols["student_id"]
-            kv[n:] = 0
-            bv = buf[4 * padded:].view(self._bank_dtype)
-            bv[:n] = banks  # all < num_banks <= sentinel
-            bv[n:] = np.iinfo(self._bank_dtype).max
             with maybe_annotate(self._profiling, "fused_step_dispatch"):
-                self.state, valid = self._step(self.state,
-                                               jax.numpy.asarray(buf))
+                valid = self._dispatch_single(cols, n, padded)
             valid_n = valid[:n]
         self.store.insert_columns({**cols, "is_valid": valid_n})
         self.metrics.batches += 1
@@ -286,6 +288,128 @@ class FusedPipeline:
         self.metrics.batch_sizes.append(n)
         self.metrics.device_seconds += time.perf_counter() - t0
         return valid_n
+
+    def _word_step(self, kw: int):
+        step = self._word_steps.get(kw)
+        if step is None:
+            step = self._word_steps[kw] = make_jitted_step_words(
+                self.params, kw, self.config.hll_precision)
+        return step
+
+    def _pick_kw(self, frame_bits: int, num_banks: int) -> int:
+        """Key width for the word wire: the frame's own max-key bits,
+        widened to the monotonic hint (fewer distinct compiled widths) —
+        but the hint is DROPPED when, after bank growth, it no longer
+        fits a word while the frame's own width still does. An outlier
+        frame must not permanently force the wider fallback wire."""
+        kw = max(frame_bits, 1)
+        hinted = max(kw, self._kw_hint)
+        return hinted if hinted + num_banks.bit_length() <= 32 else kw
+
+    def _dispatch_single(self, cols: Dict[str, np.ndarray], n: int,
+                         padded: int):
+        """Pack one frame's (key, bank) lanes and dispatch the fused step.
+
+        Wire format choice: the sustained host->device link rate is the
+        e2e ceiling (measured ~130 MB/s steady on the relay tunnel), so
+        bytes/event is directly events/sec. Preferred wire is ONE uint32
+        word per event — bank id folded into the key's spare high bits
+        (4 bytes/event); the 5-byte key+bank wire is the fallback when
+        key and bank bits don't fit one word together.
+
+        The pack itself runs in the native host runtime when available
+        (one fused max-scan + LUT-map + pack pass, hostpipe.c); the
+        numpy path is the behavior-identical fallback. On a native LUT
+        miss (a day with no registered bank yet) the banks are resolved
+        once through the numpy registration path; the native pack is
+        retried only if that actually brought the missed day into the
+        dense LUT window — out-of-window days (hashed non-calendar
+        lecture ids) reuse the resolved banks in the numpy pack instead
+        of paying a doomed second native pass.
+        """
+        sid, days = cols["student_id"], cols["lecture_day"]
+        num_banks = self.state.hll_regs.shape[0]
+        nat = self._native
+        banks = None
+        if nat is not None and self._native_skip > 0:
+            # Recent frames carried out-of-LUT-window days: the native
+            # pack would scan most of the frame just to abort. Skip it
+            # for a while, re-probing periodically in case the stream's
+            # day population shifted back to the dense window.
+            self._native_skip -= 1
+            nat = None
+        if nat is not None:
+            if self._day_base is None:
+                self._rebuild_lut(int(days.min()))
+            frame_bits = nat.max_key(sid).bit_length()
+            for _attempt in (0, 1):
+                kw = self._pick_kw(frame_bits, num_banks)
+                use_words = kw + num_banks.bit_length() <= 32
+                if use_words:
+                    words, miss = nat.pack_words(
+                        sid, days, self._day_lut, self._day_base, kw,
+                        padded)
+                else:
+                    words, miss = nat.pack_bytes(
+                        sid, days, self._day_lut, self._day_base,
+                        np.dtype(self._bank_dtype).itemsize, padded)
+                if miss < 0:
+                    if use_words:
+                        self._kw_hint = kw
+                        self.state, valid = self._word_step(kw)(
+                            self.state, jax.numpy.asarray(words))
+                    else:
+                        self.state, valid = self._step(
+                            self.state, jax.numpy.asarray(words))
+                    return valid
+                if _attempt == 1:
+                    # Missed again after full registration: this frame
+                    # has a day the dense LUT cannot cover. Bypass
+                    # native packing for the next frames (a stream with
+                    # persistent out-of-window days would pay a doomed
+                    # near-full scan per frame), re-probing later.
+                    self._native_skip = 32
+                    break
+                # Unregistered day (or LUT window shift): resolve banks
+                # once via the numpy path (registers days, may rebuild
+                # the LUT or grow banks — hence re-picking kw above).
+                banks = self._banks_for(days)
+                num_banks = self.state.hll_regs.shape[0]
+                # Retry natively only if the missed day actually landed
+                # in the LUT window; otherwise it is unresolvable —
+                # reuse the resolved banks and arm the bypass now.
+                off = int(days[miss]) - self._day_base
+                if not (0 <= off < self._LUT_SIZE
+                        and self._day_lut[off] >= 0):
+                    self._native_skip = 32
+                    break
+        # numpy pack: no native runtime, or days the dense LUT window
+        # can't cover (hashed non-calendar lecture ids far from the
+        # calendar window) — _banks_for_slow resolves those through the
+        # dict map.
+        if banks is None:
+            banks = self._banks_for(days)
+            num_banks = self.state.hll_regs.shape[0]
+        kw = self._pick_kw(int(sid.max()).bit_length(), num_banks)
+        if kw + num_banks.bit_length() <= 32:
+            self._kw_hint = kw
+            words = pack_words(sid, banks, kw, padded)
+            self.state, valid = self._word_step(kw)(
+                self.state, jax.numpy.asarray(words))
+            return valid
+        # ONE combined byte-packed transfer: B little-endian uint32
+        # keys then B narrow bank ids (dtype max = padded lane) —
+        # (4 + w) bytes/event on the link instead of 8.
+        w = np.dtype(self._bank_dtype).itemsize
+        buf = np.empty((4 + w) * padded, np.uint8)
+        kv = buf[:4 * padded].view(np.uint32)
+        kv[:n] = sid
+        kv[n:] = 0
+        bv = buf[4 * padded:].view(self._bank_dtype)
+        bv[:n] = banks  # all < num_banks <= sentinel
+        bv[n:] = np.iinfo(self._bank_dtype).max
+        self.state, valid = self._step(self.state, jax.numpy.asarray(buf))
+        return valid
 
     # -- checkpointing ------------------------------------------------------
     @property

@@ -35,6 +35,18 @@ class ColumnarEventStore:
     def __init__(self):
         self._blocks: List[Dict[str, np.ndarray]] = []
         self._lock = threading.Lock()
+        # Memoized compaction: read paths (analytics, per-lecture scans)
+        # often run many queries against an unchanged store; the concat +
+        # dedup lexsort is O(N log N) over ALL events, so it is computed
+        # once per write generation, not once per query. Callers treat
+        # the returned columns as read-only (documented on to_columns).
+        self._compacted: Dict[bool, Dict[str, np.ndarray]] = {}
+        self._write_gen = 0  # bumped by every mutation; guards the cache
+        # Original lecture-id strings for day codes inserted through the
+        # row adapter, so distinct_lecture_ids() round-trips the exact
+        # ids other layers keyed on (e.g. the generic processor's
+        # 'hll:<lecture_id>' sketch keys).
+        self._lid_of_day: Dict[int, str] = {}
 
     # -- write path (the hot side-output) -----------------------------------
     def insert_columns(self, cols: Dict[str, np.ndarray]) -> int:
@@ -47,14 +59,21 @@ class ColumnarEventStore:
         block = {name: cols[name] for name in _COLS}
         with self._lock:
             self._blocks.append(block)
+            self._compacted.clear()
+            self._write_gen += 1
         return n
 
     # -- read path -----------------------------------------------------------
     def to_columns(self, deduplicate: bool = True) -> Dict[str, np.ndarray]:
         """Compact all blocks into flat column vectors (analytics entry
-        point — no row objects, no DataFrame)."""
+        point — no row objects, no DataFrame). The result is memoized
+        until the next write; treat the returned arrays as read-only."""
         with self._lock:
+            cached = self._compacted.get(deduplicate)
+            if cached is not None:
+                return cached
             blocks = list(self._blocks)
+            gen = self._write_gen
         if not blocks:
             return {name: np.zeros(0, np.int64) for name in _COLS}
         cols = {name: np.concatenate([np.asarray(b[name]) for b in blocks])
@@ -74,6 +93,13 @@ class ColumnarEventStore:
                          | (sid[1:] != sid[:-1]))
             keep = np.sort(order[last])  # original append order
             cols = {name: arr[keep] for name, arr in cols.items()}
+        with self._lock:
+            # Any concurrent mutation since the snapshot (insert, or a
+            # truncate+reinsert that restores the same block count)
+            # invalidates this result for caching — but not for
+            # returning: it is a consistent view of the blocks it read.
+            if self._write_gen == gen:
+                self._compacted[deduplicate] = cols
         return cols
 
     def to_dataframe(self, deduplicate: bool = True) -> pd.DataFrame:
@@ -107,17 +133,28 @@ class ColumnarEventStore:
     # make --storage-backend=columnar a drop-in there too.
     def insert_batch(self, rows) -> int:
         """Append AttendanceRow-shaped objects as one column block."""
-        from attendance_tpu.pipeline.events import columns_from_events
+        from attendance_tpu.pipeline.events import (
+            _lecture_to_day, columns_from_events)
         if not rows:
             return 0
+        with self._lock:
+            for lid in {r.lecture_id for r in rows}:
+                self._lid_of_day.setdefault(_lecture_to_day(lid), lid)
         return self.insert_columns(columns_from_events(rows))
 
     def insert(self, row) -> None:
         self.insert_batch([row])
 
     def distinct_lecture_ids(self) -> List[str]:
-        """Reference-style lecture ids for the stored day codes."""
-        return [f"LECTURE_{day}" for day in self.distinct_lecture_days()]
+        """Reference-style lecture ids for the stored day codes. Ids
+        inserted through the row adapter round-trip exactly (hashed day
+        codes map back to the original string, keeping e.g. HLL keys
+        derived from the id consistent); binary-ingested calendar days
+        render as ``LECTURE_YYYYMMDD``."""
+        with self._lock:
+            lid_of_day = dict(self._lid_of_day)
+        return [lid_of_day.get(day, f"LECTURE_{day}")
+                for day in self.distinct_lecture_days()]
 
     # -- durability ----------------------------------------------------------
     def save(self, path) -> None:
@@ -137,6 +174,9 @@ class ColumnarEventStore:
     def truncate(self) -> None:
         with self._lock:
             self._blocks.clear()
+            self._compacted.clear()
+            self._lid_of_day.clear()
+            self._write_gen += 1
 
     def close(self) -> None:
         pass

@@ -177,3 +177,23 @@ def test_fused_get_attendance_stats():
     # HLL estimate within its error budget of the exact distinct count.
     assert abs(stats["unique_attendees"] - exact) <= max(3, 0.05 * exact)
     pipe.cleanup()
+
+
+def test_pick_kw_drops_stale_hint():
+    """An outlier-wide frame must not permanently disable the 4-byte
+    word wire once bank growth makes the hinted width no longer fit."""
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    pipe = FusedPipeline(Config(transport_backend="memory"),
+                         client=MemoryClient(MemoryBroker()), num_banks=256)
+    pipe._kw_hint = 23  # outlier frame pinned the hint
+    # 256 banks -> 9 bank bits: 23 + 9 == 32 still fits, hint honored
+    assert pipe._pick_kw(20, 256) == 23
+    # 512 banks -> 10 bits: hint no longer fits but the frame does
+    assert pipe._pick_kw(20, 512) == 20
+    # frame itself too wide for words: width reported as-is, caller
+    # falls back to the byte wire
+    assert pipe._pick_kw(30, 512) == 30

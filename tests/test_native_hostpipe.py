@@ -1,0 +1,253 @@
+"""Native host runtime (hostpipe.c) — differential tests vs the numpy
+path, plus the word-packed wire's step-program equivalence.
+
+The native library is built on demand by attendance_tpu.native.build
+(gcc is part of the baked toolchain); if no C compiler is available the
+native-specific tests skip and the numpy fallback tests still run —
+mirroring how the pipeline itself degrades.
+"""
+
+import numpy as np
+import pytest
+
+from attendance_tpu.native import load as load_native
+
+
+@pytest.fixture(scope="module")
+def hp():
+    pipe = load_native()
+    if pipe is None:
+        pytest.skip("no C toolchain: native host runtime unavailable")
+    return pipe
+
+
+def _fixture(n=50_000, key_bits=22, lut_days=200, num_banks=64, seed=3):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, 1 << key_bits, n, dtype=np.uint32)
+    day_base = 20260101
+    days = rng.integers(day_base, day_base + lut_days, n, dtype=np.uint32)
+    lut = np.full(1 << 14, -1, np.int32)
+    lut[:lut_days] = rng.integers(0, num_banks, lut_days)
+    return keys, days, lut, day_base
+
+
+def test_max_key_matches_numpy(hp):
+    keys, _, _, _ = _fixture()
+    assert hp.max_key(keys) == int(keys.max())
+
+
+def test_pack_words_matches_numpy(hp):
+    from attendance_tpu.models.fused import pack_words
+
+    keys, days, lut, base = _fixture()
+    padded = 1 << 16
+    kw = 22
+    words, miss = hp.pack_words(keys, days, lut, base, kw, padded)
+    assert miss == -1
+    banks = lut[days - base]
+    assert np.array_equal(words, pack_words(keys, banks, kw, padded))
+
+
+def test_pack_bytes_matches_numpy(hp):
+    keys, days, lut, base = _fixture()
+    n, padded = len(keys), 1 << 16
+    out, miss = hp.pack_bytes(keys, days, lut, base, 1, padded)
+    assert miss == -1
+    banks = lut[days - base]
+    kv = out[:4 * padded].view(np.uint32)
+    bv = out[4 * padded:]
+    assert np.array_equal(kv[:n], keys)
+    assert (kv[n:] == 0).all()
+    assert np.array_equal(bv[:n], banks.astype(np.uint8))
+    assert (bv[n:] == 0xFF).all()
+
+
+def test_pack_words_reports_first_miss(hp):
+    keys, days, lut, base = _fixture()
+    days = days.copy()
+    days[1234] = base + (1 << 14) + 7  # outside the LUT window
+    words, miss = hp.pack_words(keys, days, lut, base, 22, 1 << 16)
+    assert words is None and miss == 1234
+    # unregistered (negative LUT) day is a miss too
+    days2 = days.copy()
+    days2[1234] = base
+    lut2 = lut.copy()
+    lut2[0] = -1
+    hit = np.flatnonzero(days2 - base == 0)
+    w2, m2 = hp.pack_words(keys, days2, lut2, base, 22, 1 << 16)
+    assert w2 is None and m2 == hit[0]
+
+
+def test_strided_atb1_record_input(hp):
+    from attendance_tpu.models.fused import pack_words
+    from attendance_tpu.pipeline.events import BINARY_DTYPE
+
+    keys, days, lut, base = _fixture(n=10_000)
+    rec = np.zeros(len(keys), dtype=BINARY_DTYPE)
+    rec["student_id"] = keys
+    rec["lecture_day"] = days
+    assert hp.max_key(rec["student_id"]) == int(keys.max())
+    words, miss = hp.pack_words(rec["student_id"], rec["lecture_day"],
+                                lut, base, 22, 1 << 14)
+    assert miss == -1
+    banks = lut[days - base]
+    assert np.array_equal(words, pack_words(keys, banks, 22, 1 << 14))
+
+
+def test_word_step_matches_byte_step():
+    """fused_step_words == fused_step_bytes on identical inputs (the two
+    wire formats must be semantically interchangeable)."""
+    import jax
+    import jax.numpy as jnp
+
+    from attendance_tpu.models.bloom import bloom_add_packed
+    from attendance_tpu.models.fused import (
+        decode_counts, init_state, make_jitted_step_bytes,
+        make_jitted_step_words, pack_words)
+
+    state_a, params = init_state(capacity=10_000, num_banks=64)
+    state_b, _ = init_state(capacity=10_000, num_banks=64)
+    rng = np.random.default_rng(1)
+    roster = rng.choice(1 << 20, 5000, replace=False).astype(np.uint32)
+    pre = jax.jit(lambda b, k: bloom_add_packed(b, k, params))
+    state_a = state_a._replace(bloom_bits=pre(state_a.bloom_bits, roster))
+    state_b = state_b._replace(bloom_bits=pre(state_b.bloom_bits, roster))
+
+    n, padded = 1000, 1024
+    keys = np.where(rng.random(n) < 0.5, rng.choice(roster, n),
+                    rng.integers(1 << 20, 1 << 21, n)).astype(np.uint32)
+    banks = rng.integers(0, 64, n).astype(np.int32)
+
+    buf = np.empty(5 * padded, np.uint8)
+    kv = buf[:4 * padded].view(np.uint32)
+    kv[:n] = keys
+    kv[n:] = 0
+    buf[4 * padded:][:n] = banks.astype(np.uint8)
+    buf[4 * padded:][n:] = 0xFF
+    state_a, valid_a = make_jitted_step_bytes(params, 1)(
+        state_a, jnp.asarray(buf))
+
+    kw = int(keys.max()).bit_length()
+    words = pack_words(keys, banks, kw, padded)
+    state_b, valid_b = make_jitted_step_words(params, kw)(
+        state_b, jnp.asarray(words))
+
+    assert np.array_equal(np.asarray(valid_a)[:n], np.asarray(valid_b)[:n])
+    assert np.array_equal(np.asarray(state_a.hll_regs),
+                          np.asarray(state_b.hll_regs))
+    assert decode_counts(state_a.counts) == decode_counts(state_b.counts)
+
+
+def test_pipeline_native_vs_numpy_identical(monkeypatch):
+    """The FusedPipeline produces identical stores/sketches with the
+    native host runtime and with ATP_NATIVE=0 (numpy)."""
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    def run(native: bool):
+        import attendance_tpu.native as native_mod
+        if not native:
+            monkeypatch.setattr(native_mod, "_cached", None)
+            monkeypatch.setattr(native_mod, "_tried", True)
+        else:
+            monkeypatch.setattr(native_mod, "_tried", False)
+        config = Config(bloom_filter_capacity=20_000,
+                        transport_backend="memory")
+        client = MemoryClient(MemoryBroker())
+        pipe = FusedPipeline(config, client=client, num_banks=8)
+        roster, frames = generate_frames(4096, 512, roster_size=2000,
+                                         num_lectures=8, seed=11)
+        pipe.preload(roster)
+        producer = client.create_producer(config.pulsar_topic)
+        for f in frames:
+            producer.send(f)
+        pipe.run(max_events=4096, idle_timeout_s=0.5)
+        cols = pipe.store.to_columns()
+        regs = np.asarray(pipe.state.hll_regs)
+        counts = [pipe.count(d) for d in pipe.lecture_days()]
+        return cols, regs, counts
+
+    cols_np, regs_np, counts_np = run(native=False)
+    cols_nat, regs_nat, counts_nat = run(native=True)
+    for name in cols_np:
+        assert np.array_equal(np.asarray(cols_np[name]),
+                              np.asarray(cols_nat[name])), name
+    assert np.array_equal(regs_np, regs_nat)
+    assert counts_np == counts_nat
+
+
+def test_pipeline_mixed_calendar_and_hashed_days():
+    """Frames mixing calendar days with far-away hashed day codes (non-
+    calendar lecture ids) must process correctly: the native pack falls
+    back to the numpy path for the out-of-window days without losing
+    events or miscounting."""
+    import numpy as np
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.events import (
+        AttendanceEvent, columns_from_events, encode_planar_batch)
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(bloom_filter_capacity=5_000,
+                    transport_backend="memory")
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    pipe.preload(np.arange(100, 600, dtype=np.uint32))
+    evs = []
+    for i in range(300):
+        lid = "LECTURE_20260302" if i % 2 == 0 else "PHYS101"
+        evs.append(AttendanceEvent(100 + i, "2026-03-02T09:00:00", lid,
+                                   True, "entry"))
+    frame = encode_planar_batch(columns_from_events(evs))
+    producer = client.create_producer(config.pulsar_topic)
+    producer.send(frame)
+    pipe.run(max_events=300, idle_timeout_s=0.5)
+    cols = pipe.store.to_columns(deduplicate=False)
+    assert len(cols["student_id"]) == 300
+    assert np.asarray(cols["is_valid"], bool).all()  # all on roster
+    days = sorted(pipe.lecture_days())
+    assert len(days) == 2 and days[0] == 20260302
+    # both banks countable, each ~150 uniques
+    for day in days:
+        assert abs(pipe.count(day) - 150) <= 5
+
+
+def test_native_bypass_after_out_of_window_days():
+    """A frame with out-of-LUT-window days arms the adaptive native
+    bypass; it decays so the native path is re-probed later."""
+    import numpy as np
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.events import (
+        AttendanceEvent, columns_from_events, encode_planar_batch)
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(bloom_filter_capacity=5_000,
+                    transport_backend="memory")
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    if pipe._native is None:
+        pytest.skip("no C toolchain: native host runtime unavailable")
+    pipe.preload(np.arange(100, 600, dtype=np.uint32))
+
+    def frame(lids):
+        evs = [AttendanceEvent(100 + i, "2026-03-02T09:00:00",
+                               lids[i % len(lids)], True, "entry")
+               for i in range(64)]
+        return encode_planar_batch(columns_from_events(evs))
+
+    producer = client.create_producer(config.pulsar_topic)
+    producer.send(frame(["LECTURE_20260302", "PHYS101"]))
+    pipe.run(max_events=64, idle_timeout_s=0.3)
+    assert pipe._native_skip == 32  # doomed-native bypass armed
+    producer.send(frame(["LECTURE_20260302"]))
+    pipe.run(max_events=128, idle_timeout_s=0.3)
+    assert pipe._native_skip == 31  # decays per frame
+    assert pipe.metrics.events == 128
