@@ -241,6 +241,12 @@ def main(argv=None) -> None:
     p_par.set_defaults(fn=cmd_parity)
 
     args = parser.parse_args(argv)
+    if getattr(args, "num_shards", 1) * getattr(args, "num_replicas", 1) > 1:
+        # Must precede any device access: joining a multi-host runtime
+        # is impossible once the local-only backend initializes. No-op
+        # outside a cluster environment.
+        from attendance_tpu.parallel.multihost import init_distributed
+        init_distributed()
     args.fn(args)
 
 

@@ -77,6 +77,12 @@ class Config:
     # parallel replicas for multi-chip runs. 1/1 = single chip.
     num_shards: int = 1
     num_replicas: int = 1
+    # Replica sync cadence for the sharded engine: "query" (default)
+    # defers the HLL register-max union across replicas to PFCOUNT/
+    # snapshot time (no per-step dp collective — what lets "dp" span
+    # DCN in a multi-host mesh, parallel.multihost); "step" converges
+    # every replica after each batch. Observationally identical.
+    replica_sync: str = "query"
     # Snapshot directory for sketch checkpoint/restore ("" = disabled).
     # When set, processors restore on start and snapshot at ack barriers
     # every snapshot_every_batches batches (<= 0 = a default cadence of
@@ -102,6 +108,8 @@ class Config:
             raise ValueError(f"unknown bloom layout: {self.bloom_layout}")
         if not (4 <= self.hll_precision <= 18):
             raise ValueError(f"hll precision out of range: {self.hll_precision}")
+        if self.replica_sync not in ("step", "query"):
+            raise ValueError(f"unknown replica sync: {self.replica_sync}")
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
         return self
@@ -142,6 +150,10 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
     p.add_argument("--batch-timeout-s", type=float, default=d.batch_timeout_s)
     p.add_argument("--num-shards", type=int, default=d.num_shards)
     p.add_argument("--num-replicas", type=int, default=d.num_replicas)
+    p.add_argument("--replica-sync", choices=["step", "query"],
+                   default=d.replica_sync,
+                   help="HLL replica union cadence: per step, or "
+                   "deferred to query/snapshot (DCN-friendly default)")
     p.add_argument("--snapshot-dir", default=d.snapshot_dir)
     p.add_argument("--snapshot-every-batches", type=int,
                    default=d.snapshot_every_batches)
@@ -172,6 +184,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         hll_precision=args.hll_precision,
         num_shards=args.num_shards,
         num_replicas=args.num_replicas,
+        replica_sync=args.replica_sync,
         snapshot_dir=args.snapshot_dir,
         snapshot_every_batches=args.snapshot_every_batches,
         max_redeliveries=args.max_redeliveries,

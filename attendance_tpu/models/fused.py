@@ -229,3 +229,24 @@ def pack_words(keys, banks, key_bits: int, padded: int):
     np.bitwise_or(out[:n], np.asarray(keys, np.uint32), out=out[:n])
     out[n:] = 0xFFFFFFFF
     return out
+
+
+def pack_bytes(keys, banks, bank_dtype, padded: int):
+    """Host-side pack of the 5-byte fallback wire consumed by
+    :func:`fused_step_bytes`: uint8[(4 + w) * padded] laid out as
+    [keys as little-endian uint32 | bank ids as ``bank_dtype``], zero
+    keys and the dtype's all-ones sentinel on padding lanes. The single
+    definition of the byte-wire layout for every producer (the numpy
+    dispatch fallback here, the native runtime's atp_pack_bytes in C)."""
+    import numpy as np
+
+    n = len(keys)
+    w = np.dtype(bank_dtype).itemsize
+    out = np.empty((4 + w) * padded, np.uint8)
+    kv = out[:4 * padded].view(np.uint32)
+    kv[:n] = keys
+    kv[n:] = 0
+    bv = out[4 * padded:].view(bank_dtype)
+    bv[:n] = banks  # caller guarantees all < num_banks <= sentinel
+    bv[n:] = np.iinfo(bank_dtype).max
+    return out

@@ -1,0 +1,122 @@
+"""Multi-host (DCN) mesh construction and distributed initialization.
+
+The reference scales out by pointing more competing consumer processes
+at one Pulsar Shared subscription (reference attendance_processor
+.py:30-34); state stays in single-node Redis. This framework's
+multi-host story is the TPU-native inverse: ONE logical program spans
+every host via `jax.distributed`, and the sketch state itself is laid
+out so the slow link does the least work:
+
+  * "sp" (sketch shards)  -> intra-host ICI. The per-step collective —
+    the per-key validity AND (`pmin` in ShardedSketchEngine) — rides
+    the fast fabric.
+  * "dp" (replicas)       -> across hosts / DCN. With the engine's
+    deferred replica sync (``replica_sync="query"``, the default) NO
+    per-step collective crosses this axis at all: each host's replica
+    accumulates privately, and the commutative register-max union runs
+    once per PFCOUNT/snapshot. DCN latency therefore bounds only query
+    latency, never event throughput.
+
+Single-process runs (tests, the one-chip bench, the virtual CPU mesh)
+fall through to the plain `make_mesh` over local devices — the entry
+points here are no-ops unless a multi-process environment is
+configured, so every code path is exercisable without a pod.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from attendance_tpu.parallel.sharded import make_mesh
+
+logger = logging.getLogger(__name__)
+
+
+_init_attempted = False
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Join (or form) a multi-host JAX runtime; returns True if a
+    multi-process runtime is active afterwards.
+
+    Must run before any other JAX activity in the process (device
+    enumeration initializes the local-only backend, after which joining
+    a cluster is impossible — so this function itself touches no
+    devices until after the initialize attempt). With no arguments it
+    runs `jax.distributed.initialize()`'s cluster auto-detection (TPU
+    pod metadata, SLURM, ...) and degrades to a logged no-op when no
+    cluster environment exists — safe to call unconditionally.
+    """
+    global _init_attempted
+    if num_processes is not None or process_id is not None:
+        if coordinator_address is None:
+            raise ValueError(
+                "num_processes/process_id require coordinator_address")
+    if _init_attempted:
+        return jax.process_count() > 1
+    _init_attempted = True
+    try:
+        if coordinator_address is not None:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        else:
+            # No-arg form performs the cluster auto-detection; outside
+            # any cluster it raises, which is the expected single-host
+            # outcome, not an error.
+            jax.distributed.initialize()
+    except Exception as exc:  # noqa: BLE001 — single-host fallback
+        if coordinator_address is not None:
+            raise  # an explicit join must not fail silently
+        logger.debug("no cluster environment detected (%s): "
+                     "running single-host", exc)
+    return jax.process_count() > 1
+
+
+def make_multihost_mesh(num_shards: int = 1,
+                        num_replicas: Optional[int] = None) -> Mesh:
+    """A (dp, sp) mesh whose "sp" axis stays inside each host's ICI
+    domain and whose "dp" axis spans hosts (DCN).
+
+    Multi-process: requires ``num_shards`` to divide the per-host device
+    count (a shard group must not straddle DCN), and ``num_replicas``
+    defaults to every remaining device. Single-process: identical to
+    `make_mesh` (including on the virtual CPU mesh), so tests and the
+    dryrun exercise the same code path.
+    """
+    n_procs = jax.process_count()
+    if n_procs <= 1:
+        if num_replicas is None:
+            num_replicas = max(1, len(jax.devices()) // num_shards)
+        return make_mesh(num_shards, num_replicas)
+
+    per_host = jax.local_device_count()
+    if per_host % num_shards:
+        raise ValueError(
+            f"num_shards={num_shards} must divide the per-host device "
+            f"count ({per_host}): a sharded sketch's per-step AND must "
+            "ride ICI, never DCN")
+    replicas_per_host = per_host // num_shards
+    total_replicas = replicas_per_host * n_procs
+    if num_replicas is None:
+        num_replicas = total_replicas
+    if num_replicas != total_replicas:
+        raise ValueError(
+            f"num_replicas={num_replicas} != hosts*per-host replicas "
+            f"({total_replicas}); leave it unset to use every device")
+    # jax.devices() orders devices host-major; reshape so axis 0 (dp)
+    # strides across hosts last — consecutive sp neighbors share a host.
+    dev = np.asarray(jax.devices()).reshape(
+        n_procs, replicas_per_host, num_shards)
+    dev = dev.reshape(num_replicas, num_shards)
+    mesh = Mesh(dev, axis_names=("dp", "sp"))
+    logger.info("multihost mesh: %d hosts x %d devices -> dp=%d sp=%d",
+                n_procs, per_host, num_replicas, num_shards)
+    return mesh

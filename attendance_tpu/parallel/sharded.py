@@ -4,8 +4,11 @@ State layout (per SURVEY.md §2.3 "hash-prefix sharding"):
   * Bloom bit array  uint32[m_bits/32]    — bit-packed words, axis 0
                                             split across "sp",
                                             replicated across "dp".
-  * HLL banks        uint8[banks, m_regs] — register axis split across
-                                            "sp", replicated across "dp".
+  * HLL banks        uint8[dp, banks, m_regs]
+                                          — leading replica axis split
+                                            across "dp" (each replica's
+                                            private copy), register axis
+                                            split across "sp".
   * Event batch      uint32[B] keys (+ int32[B] bank ids)
                                           — split across "dp",
                                             replicated across "sp".
@@ -18,9 +21,21 @@ backend"):
 
   * query:  AND across "sp" (each shard answers for the probes it owns),
             implemented as a min-reduce; counts via histogram psum.
-  * update: OR across "dp" for Bloom (max-reduce over {0,1} bytes) and
-            register-max across "dp" for HLL, so every replica converges
-            to the union state after each batch.
+  * update: OR across "dp" for Bloom (preload-time only — the hot loop
+            never writes the filter) and register-max across "dp" for
+            HLL.
+
+Replica sync cadence (``replica_sync``): HLL register union across "dp"
+is commutative/idempotent max, so it can happen at EVERY step
+("step" mode: each batch leaves all replicas converged) or be DEFERRED
+to query time ("query" mode, the default: each replica owns a private
+register copy — regs carry a leading dp axis sharded over "dp" — and
+the union max runs once per PFCOUNT/snapshot). Deferral removes the
+only per-step cross-replica collective, which is what makes "dp" safe
+to map onto DCN in a multi-host mesh (parallel.multihost): steady-state
+step traffic is then just the per-key validity AND riding "sp" (ICI).
+The modes are observationally identical — max is associative — and
+tested as such.
 
 With the "blocked" Bloom layout every key's k probes live in one 512-bit
 block, so exactly one "sp" shard does real work per key — the gather
@@ -70,10 +85,15 @@ class ShardedSketchEngine:
     def __init__(self, mesh: Mesh, capacity: int, error_rate: float,
                  num_banks: int = 64, precision: int = 14,
                  layout: str = "blocked",
-                 params: Optional[BloomParams] = None):
+                 params: Optional[BloomParams] = None,
+                 replica_sync: str = "query"):
+        if replica_sync not in ("step", "query"):
+            raise ValueError(f"replica_sync must be 'step' or 'query', "
+                             f"got {replica_sync!r}")
         self.mesh = mesh
         self.sp = mesh.shape["sp"]
         self.dp = mesh.shape["dp"]
+        self.replica_sync = replica_sync
         self.precision = precision
         self.params = params or derive_bloom_params(
             capacity, error_rate, layout)
@@ -93,11 +113,17 @@ class ShardedSketchEngine:
         self.num_banks = num_banks
 
         bits_sharding = NamedSharding(mesh, P("sp"))
-        regs_sharding = NamedSharding(mesh, P(None, "sp"))
+        # HLL registers carry a leading replica axis: regs[r] is replica
+        # r's private register copy (sharded over "dp"; register axis
+        # over "sp"). In "step" mode every step's pmax keeps all copies
+        # identical; in "query" mode they diverge freely and the
+        # commutative max-union happens once at histogram time.
+        regs_sharding = NamedSharding(mesh, P("dp", None, "sp"))
         self.bits = jax.device_put(
             jnp.zeros((self.m_words,), jnp.uint32), bits_sharding)
         self.regs = jax.device_put(
-            jnp.zeros((num_banks, self.m_regs), jnp.uint8), regs_sharding)
+            jnp.zeros((self.dp, num_banks, self.m_regs), jnp.uint8),
+            regs_sharding)
         self._build_kernels()
 
     # -- shard_map kernels --------------------------------------------------
@@ -106,6 +132,7 @@ class ShardedSketchEngine:
         params = self.params
         precision = self.precision
         dp = self.dp
+        sync_every_step = self.replica_sync == "step"
         m_words_local = self.m_words // self.sp
         m_local = m_words_local * 32  # filter bits per sp slice
         regs_local = self.m_regs // self.sp
@@ -145,6 +172,8 @@ class ShardedSketchEngine:
             return words_loc
 
         def hll_add_local(regs_loc, bank_idx, keys, mask):
+            # regs_loc: uint8[1, banks, regs_local] — this replica's
+            # private slice (leading dp axis is size 1 per device).
             bucket, rank = hll_bucket_rank(keys, precision)
             lo = jax.lax.axis_index("sp").astype(jnp.int32) * regs_local
             rel = bucket - lo
@@ -152,9 +181,12 @@ class ShardedSketchEngine:
             flat = jnp.where(keep, bank_idx * regs_local + rel,
                              regs_loc.size)
             out = regs_loc.reshape(-1).at[flat].max(
-                rank.astype(jnp.uint8), mode="drop")
-            # register-max allreduce across replicas.
-            return jax.lax.pmax(out.reshape(regs_loc.shape), "dp")
+                rank.astype(jnp.uint8), mode="drop").reshape(regs_loc.shape)
+            if sync_every_step:
+                # register-max allreduce across replicas each batch;
+                # in "query" mode this union is deferred to _hist.
+                out = jax.lax.pmax(out, "dp")
+            return out
 
         def step_kernel(bits_loc, regs_loc, keys, bank_idx, mask):
             """Fused hot-loop step on one device: validate the local batch
@@ -172,14 +204,23 @@ class ShardedSketchEngine:
             return jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
 
         def hist_kernel(regs_loc):
-            """Full register histogram per bank: psum of per-slice
-            histograms across sp."""
+            """Full register histogram per bank: replica max-union across
+            dp (the deferred sync point in "query" mode; a no-op value-
+            wise in "step" mode), then psum of per-slice histograms
+            across sp. Histogramming must follow the union — the
+            histogram of a max is not the max of histograms."""
+            merged = jax.lax.pmax(regs_loc, "dp")[0]
             q = 64 - precision
             hist = jax.vmap(lambda bank: jnp.bincount(
-                bank.astype(jnp.int32), length=q + 2))(regs_loc)
+                bank.astype(jnp.int32), length=q + 2))(merged)
             return jax.lax.psum(hist, "sp")
 
         smap = functools.partial(jax.shard_map, mesh=mesh)
+        # Device-side replica merge for host reads: ships 1x the
+        # register state over the host link instead of all dp private
+        # copies (D2H volume is the expensive resource — see the
+        # platform notes in pipeline.fast_path.run).
+        self._merge_regs = jax.jit(lambda r: jnp.max(r, axis=0))
         # check_vma=False: the all_gather+OR leaves every dp replica with
         # the identical union filter, but the static varying-axes checker
         # cannot infer that replication through the elementwise ORs.
@@ -188,15 +229,16 @@ class ShardedSketchEngine:
             in_specs=(P("sp"), P("dp"), P("dp")),
             out_specs=P("sp"), check_vma=False),
             donate_argnums=(0,))
+        regs_spec = P("dp", None, "sp")
         self._step = jax.jit(smap(
             step_kernel,
-            in_specs=(P("sp"), P(None, "sp"), P("dp"), P("dp"), P("dp")),
-            out_specs=(P("dp"), P(None, "sp"))),
+            in_specs=(P("sp"), regs_spec, P("dp"), P("dp"), P("dp")),
+            out_specs=(P("dp"), regs_spec)),
             donate_argnums=(1,))
         self._query = jax.jit(smap(
             query_kernel, in_specs=(P("sp"), P("dp")), out_specs=P("dp")))
         self._hist = jax.jit(smap(
-            hist_kernel, in_specs=(P(None, "sp"),), out_specs=P(None)))
+            hist_kernel, in_specs=(regs_spec,), out_specs=P(None)))
 
     # -- padded batch helpers ------------------------------------------------
     def _pad(self, arr: np.ndarray, fill, dtype) -> Tuple[np.ndarray, int]:
@@ -253,14 +295,23 @@ class ShardedSketchEngine:
         kbuf, n = self._pad(keys, 0, np.uint32)
         return np.asarray(self._query(self.bits, jnp.asarray(kbuf)))[:n]
 
+    def _put_merged_regs(self, merged: np.ndarray) -> None:
+        """Install a merged (banks, m_regs) register state: replica 0
+        carries it, the others start zeroed — equivalent under max-union
+        and dp-times cheaper to ship than tiling every replica."""
+        tiled = np.zeros((self.dp,) + merged.shape, np.uint8)
+        tiled[0] = merged
+        self.regs = jax.device_put(
+            jnp.asarray(tiled),
+            NamedSharding(self.mesh, P("dp", None, "sp")))
+
     def grow_banks(self, new_num_banks: int) -> None:
         """Double-style bank growth (rare; one host round-trip + reshard)."""
-        regs_host = np.asarray(self.regs)
+        merged = np.asarray(self._merge_regs(self.regs))
         grown = np.zeros((new_num_banks, self.m_regs), np.uint8)
-        grown[:regs_host.shape[0]] = regs_host
+        grown[:merged.shape[0]] = merged
         self.num_banks = new_num_banks
-        self.regs = jax.device_put(
-            jnp.asarray(grown), NamedSharding(self.mesh, P(None, "sp")))
+        self._put_merged_regs(grown)
 
     def get_state(self) -> Tuple[np.ndarray, np.ndarray]:
         """Host copies of (packed bloom words, HLL register banks).
@@ -271,7 +322,8 @@ class ShardedSketchEngine:
         to/from the single-chip pipeline).
         """
         real_words = self.params.m_bits // 32
-        return np.asarray(self.bits)[:real_words], np.asarray(self.regs)
+        return (np.asarray(self.bits)[:real_words],
+                np.asarray(self._merge_regs(self.regs)))
 
     def set_state(self, bits: np.ndarray, regs: np.ndarray) -> None:
         """Restore state captured by get_state (or by the single-chip
@@ -287,8 +339,7 @@ class ShardedSketchEngine:
         self.num_banks = regs.shape[0]
         self.bits = jax.device_put(
             jnp.asarray(padded), NamedSharding(self.mesh, P("sp")))
-        self.regs = jax.device_put(
-            jnp.asarray(regs), NamedSharding(self.mesh, P(None, "sp")))
+        self._put_merged_regs(np.asarray(regs, dtype=np.uint8))
 
     def count(self, bank: int) -> int:
         """PFCOUNT of one bank (Ertl estimator over the psum'd histogram)."""

@@ -57,7 +57,7 @@ from attendance_tpu.config import Config
 from attendance_tpu.models.bloom import bloom_add_packed
 from attendance_tpu.models.fused import (
     bank_wire_dtype, init_state, make_jitted_step_bytes,
-    make_jitted_step_words, pack_words)
+    make_jitted_step_words, pack_bytes, pack_words)
 from attendance_tpu.models.hll import (
     best_histogram, estimate_from_histogram)
 from attendance_tpu.pipeline.events import decode_binary_batch
@@ -94,16 +94,21 @@ class FusedPipeline:
         self.sharded = (self.config.num_shards
                         * self.config.num_replicas) > 1
         if self.sharded:
-            from attendance_tpu.parallel.sharded import (
-                ShardedSketchEngine, make_mesh)
+            from attendance_tpu.parallel.multihost import (
+                init_distributed, make_multihost_mesh)
+            from attendance_tpu.parallel.sharded import ShardedSketchEngine
+            if mesh is None:
+                init_distributed()  # no-op outside a cluster environment
+                mesh = make_multihost_mesh(self.config.num_shards,
+                                           self.config.num_replicas)
             self.engine = ShardedSketchEngine(
-                mesh or make_mesh(self.config.num_shards,
-                                  self.config.num_replicas),
+                mesh,
                 capacity=self.config.bloom_filter_capacity,
                 error_rate=self.config.bloom_filter_error_rate,
                 num_banks=num_banks,
                 precision=self.config.hll_precision,
-                layout="blocked")
+                layout="blocked",
+                replica_sync=self.config.replica_sync)
             self.params = self.engine.params
         else:
             self.engine = None
@@ -400,14 +405,7 @@ class FusedPipeline:
         # ONE combined byte-packed transfer: B little-endian uint32
         # keys then B narrow bank ids (dtype max = padded lane) —
         # (4 + w) bytes/event on the link instead of 8.
-        w = np.dtype(self._bank_dtype).itemsize
-        buf = np.empty((4 + w) * padded, np.uint8)
-        kv = buf[:4 * padded].view(np.uint32)
-        kv[:n] = sid
-        kv[n:] = 0
-        bv = buf[4 * padded:].view(self._bank_dtype)
-        bv[:n] = banks  # all < num_banks <= sentinel
-        bv[n:] = np.iinfo(self._bank_dtype).max
+        buf = pack_bytes(sid, banks, self._bank_dtype, padded)
         self.state, valid = self._step(self.state, jax.numpy.asarray(buf))
         return valid
 

@@ -1,0 +1,34 @@
+"""Multi-host mesh helper (parallel.multihost) — single-process paths.
+
+A real multi-process DCN run needs a pod; these tests pin down the
+single-process fallbacks and the constraint validation, and the
+virtual-8-device conftest mesh exercises the same (dp, sp) axis layout
+the multi-host path produces.
+"""
+
+import jax
+import pytest
+
+from attendance_tpu.parallel.multihost import (
+    init_distributed, make_multihost_mesh)
+
+
+def test_init_distributed_is_noop_single_process():
+    assert init_distributed() is False
+    assert jax.process_count() == 1
+
+
+def test_init_distributed_rejects_partial_args():
+    with pytest.raises(ValueError):
+        init_distributed(num_processes=2)
+
+
+def test_make_multihost_mesh_single_process_fallback():
+    mesh = make_multihost_mesh(num_shards=2, num_replicas=4)
+    assert mesh.shape == {"dp": 4, "sp": 2}
+
+
+def test_make_multihost_mesh_defaults_replicas_to_all_devices():
+    mesh = make_multihost_mesh(num_shards=2)
+    assert mesh.shape["sp"] == 2
+    assert mesh.shape["dp"] == len(jax.devices()) // 2

@@ -105,3 +105,74 @@ def test_sharded_hist_matches_numpy_oracle():
     eng.preload(keys)
     eng.step(keys, np.zeros(len(keys), dtype=np.int32))
     assert eng.count(0) == oracle
+
+
+def test_replica_sync_modes_equivalent():
+    """'step' (per-batch union) and 'query' (deferred union) replica
+    sync must be observationally identical: same validity, same counts,
+    same merged snapshot state."""
+    import numpy as np
+
+    from attendance_tpu.parallel.sharded import (
+        ShardedSketchEngine, make_mesh)
+
+    rng = np.random.default_rng(5)
+    roster = rng.choice(1 << 20, 4000, replace=False).astype(np.uint32)
+    engines = {}
+    for mode in ("step", "query"):
+        eng = ShardedSketchEngine(make_mesh(num_shards=2, num_replicas=4),
+                                  capacity=10_000, error_rate=0.01,
+                                  num_banks=4, replica_sync=mode)
+        eng.preload(roster)
+        engines[mode] = eng
+
+    valids = {}
+    for mode, eng in engines.items():
+        outs = []
+        for i in range(6):
+            keys = np.where(rng.random(500) < 0.5,
+                            roster[(np.arange(500) * (i + 7)) % len(roster)],
+                            (1 << 21) + np.arange(500) * (i + 1)
+                            ).astype(np.uint32)
+            banks = (np.arange(500) % 4).astype(np.int32)
+            outs.append(np.asarray(eng.step(keys, banks)))
+        valids[mode] = outs
+        rng = np.random.default_rng(5)
+        rng.choice(1 << 20, 4000, replace=False)  # re-sync the stream rng
+
+    for a, b in zip(valids["step"], valids["query"]):
+        assert np.array_equal(a, b)
+    for bank in range(4):
+        assert engines["step"].count(bank) == engines["query"].count(bank)
+    bits_s, regs_s = engines["step"].get_state()
+    bits_q, regs_q = engines["query"].get_state()
+    assert np.array_equal(bits_s, bits_q)
+    assert np.array_equal(regs_s, regs_q)
+
+
+def test_replica_sync_cross_mode_restore():
+    """A snapshot taken in one sync mode restores into the other (state
+    is merged/global in both)."""
+    import numpy as np
+
+    from attendance_tpu.parallel.sharded import (
+        ShardedSketchEngine, make_mesh)
+
+    rng = np.random.default_rng(9)
+    roster = rng.choice(1 << 20, 2000, replace=False).astype(np.uint32)
+    src = ShardedSketchEngine(make_mesh(num_shards=4, num_replicas=2),
+                              capacity=10_000, error_rate=0.01,
+                              num_banks=4, replica_sync="query")
+    src.preload(roster)
+    keys = roster[:1000]
+    banks = (np.arange(1000) % 4).astype(np.int32)
+    src.step(keys, banks)
+    bits, regs = src.get_state()
+
+    dst = ShardedSketchEngine(make_mesh(num_shards=2, num_replicas=4),
+                              capacity=10_000, error_rate=0.01,
+                              num_banks=4, replica_sync="step")
+    dst.set_state(bits, regs)
+    for bank in range(4):
+        assert dst.count(bank) == src.count(bank)
+    assert np.asarray(dst.contains(keys)).all()
