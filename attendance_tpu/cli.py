@@ -140,6 +140,22 @@ def cmd_fused(args) -> None:
         pipe.cleanup()
 
 
+def cmd_bridge(args) -> None:
+    """Run the JSON->binary ingress bridge until idle (or max events)."""
+    from attendance_tpu.pipeline.bridge import JsonBinaryBridge
+
+    config = config_from_args(args)
+    bridge = JsonBinaryBridge(config, out_topic=args.out_topic or None)
+    try:
+        bridge.run(max_events=args.max_events,
+                   idle_timeout_s=args.idle_timeout_s)
+        m = bridge.metrics
+        logger.info("Bridged %d events in %d frames (%.0f ev/s)",
+                    m.events, m.batches, m.events_per_second)
+    finally:
+        bridge.cleanup()
+
+
 def cmd_pipeline(args) -> None:
     """Hermetic end-to-end run: generate -> process -> analyze in-process."""
     from attendance_tpu.pipeline.analyzer import AttendanceAnalyzer
@@ -229,6 +245,17 @@ def main(argv=None) -> None:
     p_fast.add_argument("--num-lectures", type=int, default=16)
     p_fast.add_argument("--seed", type=int, default=0)
     p_fast.set_defaults(fn=cmd_fused)
+
+    p_br = sub.add_parser(
+        "bridge", help="JSON -> binary ingress bridge: drain the "
+        "reference-wire JSON topic, repack micro-batches as planar "
+        "binary frames on <topic>-binary for the fused pipeline")
+    add_flags(p_br)
+    p_br.add_argument("--out-topic", default="",
+                      help="binary output topic (default <topic>-binary)")
+    p_br.add_argument("--max-events", type=int, default=None)
+    p_br.add_argument("--idle-timeout-s", type=float, default=1.0)
+    p_br.set_defaults(fn=cmd_bridge)
 
     p_par = sub.add_parser(
         "parity", help="differential tpu-vs-redis accuracy check "

@@ -26,6 +26,42 @@ _lock = threading.Lock()
 _cached: Optional["HostPipe"] = None
 _tried = False
 
+
+class PreparedJsonBatch:
+    """Concatenated payload buffer + offset/length tables + output
+    columns for the resumable JSON scan (HostPipe.parse_json_from)."""
+
+    __slots__ = ("buf", "offs", "lens", "student", "day", "micros",
+                 "flags")
+
+    def __init__(self, buf, offs, lens, student, day, micros, flags):
+        self.buf = buf
+        self.offs = offs
+        self.lens = lens
+        self.student = student
+        self.day = day
+        self.micros = micros
+        self.flags = flags
+
+    def set_row(self, i: int, cols) -> None:
+        """Fill one row from a single-event Python-parsed column dict
+        (the fallback path for non-fast-shape payloads)."""
+        self.student[i] = cols["student_id"][0]
+        self.day[i] = cols["lecture_day"][0]
+        self.micros[i] = cols["micros"][0]
+        self.flags[i] = (int(bool(cols["is_valid"][0]))
+                         | (int(cols["event_type"][0]) << 1))
+
+    def columns(self, k: Optional[int] = None) -> dict:
+        k = len(self.student) if k is None else k
+        return {
+            "student_id": self.student[:k],
+            "lecture_day": self.day[:k],
+            "micros": self.micros[:k],
+            "is_valid": (self.flags[:k] & 1).astype(bool),
+            "event_type": ((self.flags[:k] >> 1) & 1).astype(np.int8),
+        }
+
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _u32p = ctypes.POINTER(ctypes.c_uint32)
@@ -54,6 +90,11 @@ class HostPipe:
             ctypes.c_size_t, ctypes.c_size_t,
             _i32p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
             _u8p]
+        lib.atp_parse_json_events.restype = ctypes.c_int64
+        lib.atp_parse_json_events.argtypes = [
+            _u8p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            _u32p, _u32p, ctypes.POINTER(ctypes.c_int64), _u8p]
 
     # -- column access helpers ----------------------------------------------
     @staticmethod
@@ -85,6 +126,59 @@ class HostPipe:
         if rc == 0:
             return out, -1
         return None, int(rc - 1)
+
+    def prepare_json_batch(self, payloads) -> "PreparedJsonBatch":
+        """One-time O(total bytes) setup for a batch of JSON payloads;
+        parse with :meth:`parse_json_from` (resumable by index, so a
+        mixed stream costs one setup, not one per fallback payload)."""
+        n = len(payloads)
+        lens = np.fromiter((len(p) for p in payloads), np.uint32, count=n)
+        offs = np.zeros(n, np.uint64)
+        if n > 1:
+            np.cumsum(lens[:-1], out=offs[1:])
+        return PreparedJsonBatch(
+            buf=np.frombuffer(b"".join(payloads), np.uint8),
+            offs=offs, lens=lens,
+            student=np.empty(n, np.uint32), day=np.empty(n, np.uint32),
+            micros=np.empty(n, np.int64), flags=np.empty(n, np.uint8))
+
+    def parse_json_from(self, b: "PreparedJsonBatch", start: int) -> int:
+        """Scan payloads [start, n) into the batch's output arrays.
+        Returns -1 when everything parsed, else the ABSOLUTE index of
+        the first payload outside the fast schema (entries before it
+        are filled; the caller Python-parses that one and resumes at
+        index + 1)."""
+        n = len(b.offs) - start
+        if n <= 0:
+            return -1
+        rc = self._lib.atp_parse_json_events(
+            _ptr(b.buf, _u8p),
+            b.offs[start:].ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint64)),
+            b.lens[start:].ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint32)),
+            n, _ptr(b.student[start:], _u32p), _ptr(b.day[start:], _u32p),
+            b.micros[start:].ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int64)),
+            _ptr(b.flags[start:], _u8p))
+        return -1 if rc == 0 else start + int(rc - 1)
+
+    def parse_json_events(self, payloads) -> Tuple[dict, int]:
+        """One-shot convenience over prepare/parse: returns
+        (columns, -1) on success, or (columns_of_the_parsed_prefix,
+        first_failed_index)."""
+        if len(payloads) == 0:
+            return {
+                "student_id": np.zeros(0, np.uint32),
+                "lecture_day": np.zeros(0, np.uint32),
+                "micros": np.zeros(0, np.int64),
+                "is_valid": np.zeros(0, bool),
+                "event_type": np.zeros(0, np.int8),
+            }, -1
+        b = self.prepare_json_batch(payloads)
+        miss = self.parse_json_from(b, 0)
+        k = len(payloads) if miss < 0 else miss
+        return b.columns(k), miss
 
     def pack_bytes(self, keys: np.ndarray, days: np.ndarray,
                    lut: np.ndarray, day_base: int, bank_width: int,

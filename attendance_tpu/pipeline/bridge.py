@@ -1,0 +1,114 @@
+"""JSON -> binary ingress bridge: reference wire in, fused wire out.
+
+The reference's producers emit ONE JSON object per broker message
+(reference data_generator.py:112-123); the fused pipeline consumes bulk
+binary frames. This bridge connects them: it drains the JSON topic in
+micro-batches, parses the batch through the native schema scanner
+(events.decode_json_batch_columns — ~8x per-event json.loads end to
+end), packs the columns into one planar binary frame, republishes
+it on the binary topic, and only then acknowledges the JSON messages —
+so the bridge is at-least-once end to end, and a crash replays JSON
+messages into duplicate binary frames that the idempotent sketches and
+last-write-wins store absorb (SURVEY.md §5).
+
+This is the "batched decode + binary framing before the device" stage
+SURVEY.md §7 hard part (d) prescribes for JSON ingress at north-star
+rates, packaged as its own competing-consumer component: run several
+bridges on one shared subscription to scale JSON decode horizontally,
+exactly how the reference scales its processor
+(attendance_processor.py:30-34).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import numpy as np
+
+from attendance_tpu.config import Config
+from attendance_tpu.pipeline.events import (
+    columns_from_events, decode_event, decode_json_batch_columns,
+    encode_planar_batch)
+from attendance_tpu.pipeline.processor import ProcessorMetrics
+from attendance_tpu.transport import collect_batch, handle_poison, make_client
+
+logger = logging.getLogger(__name__)
+
+BINARY_TOPIC_SUFFIX = "-binary"
+
+
+class JsonBinaryBridge:
+    """Competing-consumer JSON->binary repacker."""
+
+    SUBSCRIPTION = "attendance_bridge"
+
+    def __init__(self, config: Optional[Config] = None, *,
+                 client=None, out_topic: Optional[str] = None):
+        self.config = config or Config()
+        self.client = client or make_client(self.config)
+        self.consumer = self.client.subscribe(
+            self.config.pulsar_topic, self.SUBSCRIPTION)
+        self.out_topic = (out_topic
+                          or self.config.pulsar_topic + BINARY_TOPIC_SUFFIX)
+        self.producer = self.client.create_producer(self.out_topic)
+        self.metrics = ProcessorMetrics()
+
+    def _forward(self, msgs) -> None:
+        payloads = [m.data() for m in msgs]
+        try:
+            cols = decode_json_batch_columns(payloads)
+            good = msgs
+        except Exception:
+            # A poison payload somewhere in the batch: convert per
+            # message so only the bad ones dead-letter (bounded retry,
+            # the fused pipeline's poison policy). The per-message
+            # probe runs the FULL conversion — valid JSON with, say, an
+            # unparseable timestamp is just as poisonous as bad JSON
+            # and must dead-letter, not crash the bridge into an
+            # unrecoverable redelivery loop.
+            good, parts = [], []
+            for m in msgs:
+                try:
+                    parts.append(columns_from_events(
+                        [decode_event(m.data())]))
+                    good.append(m)
+                except Exception:
+                    handle_poison(m, self.consumer, self.metrics,
+                                  self.config, logger, count_nack=False)
+            if not good:
+                return
+            cols = {k: np.concatenate([p[k] for p in parts])
+                    for k in parts[0]}
+        self.producer.send(encode_planar_batch(cols))
+        # Ack strictly after the binary frame is published: the bridge
+        # never holds the only copy of an acknowledged event.
+        for m in good:
+            self.consumer.acknowledge(m)
+        self.metrics.batches += 1
+        self.metrics.events += len(good)
+        self.metrics.batch_sizes.append(len(good))
+
+    def run(self, max_events: Optional[int] = None,
+            idle_timeout_s: float = 1.0) -> None:
+        t0 = time.perf_counter()
+        idle_since = time.monotonic()
+        while True:
+            msgs = collect_batch(self.consumer, self.config.batch_size,
+                                 self.config.batch_timeout_s)
+            if not msgs:
+                if time.monotonic() - idle_since > idle_timeout_s:
+                    break
+                continue
+            idle_since = time.monotonic()
+            self._forward(msgs)
+            if max_events is not None and self.metrics.events >= max_events:
+                break
+        self.metrics.wall_seconds = time.perf_counter() - t0
+        if logger.isEnabledFor(logging.INFO):
+            logger.info("Bridge metrics: %s",
+                        self.metrics.summary(None, include_validity=False))
+
+    def cleanup(self) -> None:
+        self.client.close()

@@ -21,7 +21,7 @@ from __future__ import annotations
 import json
 import struct
 from dataclasses import dataclass
-from datetime import datetime, timezone
+from datetime import datetime, timedelta, timezone
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -87,16 +87,23 @@ def decode_event_batch(frames: Sequence[bytes]) -> List[AttendanceEvent]:
 # Binary fast path
 # ---------------------------------------------------------------------------
 
+_EPOCH = datetime(1970, 1, 1, tzinfo=timezone.utc)
+
+
 def _iso_to_micros(ts: str) -> int:
     # Naive timestamps are pinned to UTC so micros is a pure function of
     # the wall-clock string: `(micros // 3_600e6) % 24` recovers the hour
     # written in the event on any host timezone, keeping the columnar
     # analytics path in agreement with the row path (which parses the
-    # string directly).
+    # string directly). Integer timedelta division, NOT
+    # int(dt.timestamp() * 1e6): the float product truncates ~1% of
+    # fractional timestamps one microsecond low, which would diverge
+    # from the native scanner's exact arithmetic (hostpipe.c
+    # parse_iso_micros) and break replay/dedup equality across paths.
     dt = datetime.fromisoformat(ts)
     if dt.tzinfo is None:
         dt = dt.replace(tzinfo=timezone.utc)
-    return int(dt.timestamp() * 1e6)
+    return (dt - _EPOCH) // timedelta(microseconds=1)
 
 
 _HASH_DAY_BASE = 100_000_000           # above any yyyymmdd calendar value
@@ -226,6 +233,40 @@ def decode_planar_batch(data: bytes,
     if include_truth:
         cols["is_valid"] = (flags & 1).astype(bool)
     return cols
+
+
+def decode_json_batch_columns(payloads: Sequence[bytes]
+                              ) -> Dict[str, np.ndarray]:
+    """Reference-wire JSON payloads -> binary columns, batched.
+
+    Fast path: the native host runtime's schema-specific scanner
+    (hostpipe.c atp_parse_json_events, ~8x json.loads end to end).
+    Payloads outside the fast shape (escape sequences, timezone
+    suffixes, non-calendar lecture ids needing murmur3) are
+    Python-parsed INDIVIDUALLY and the native scan resumes after each —
+    a mixed stream keeps the fast path for its conforming majority
+    instead of degrading whole batches. Results are identical either
+    way (tested differentially, including the exact-microsecond
+    timestamp arithmetic both sides share). Raises on malformed JSON
+    like decode_event does; callers keep per-message poison handling."""
+    from attendance_tpu.native import load as load_native
+
+    nat = load_native()
+    if nat is None or not payloads:
+        return columns_from_events(decode_event_batch(payloads))
+    payloads = [bytes(p) for p in payloads]
+    batch = nat.prepare_json_batch(payloads)  # one O(bytes) setup
+    idx = 0
+    while True:
+        miss = nat.parse_json_from(batch, idx)
+        if miss < 0:
+            return batch.columns()
+        # Python codec for the one non-fast-shape payload (written
+        # straight into its output row), then resume the native scan
+        # after it — O(1) setup per resume, not a tail re-join.
+        batch.set_row(miss, columns_from_events(
+            [decode_event(payloads[miss])]))
+        idx = miss + 1
 
 
 def columns_from_events(events: Sequence[AttendanceEvent]

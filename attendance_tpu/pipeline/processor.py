@@ -245,19 +245,12 @@ class AttendanceProcessor:
     # -- streaming loop -----------------------------------------------------
     def _collect_batch(self) -> List:
         """Fill a batch from the consumer: up to batch_size messages, or
-        whatever arrived when batch_timeout_s expires (partial batch)."""
-        msgs = []
-        deadline = time.monotonic() + self.config.batch_timeout_s
-        while len(msgs) < self.config.batch_size:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0 and msgs:
-                break
-            timeout_ms = max(1, int(max(remaining, 0) * 1000))
-            try:
-                msgs.append(self.consumer.receive(timeout_millis=timeout_ms))
-            except ReceiveTimeout:
-                break
-        return msgs
+        whatever arrived when batch_timeout_s expires (partial batch).
+        One definition for all micro-batching consumers
+        (transport.collect_batch; the bridge shares it)."""
+        from attendance_tpu.transport import collect_batch
+        return collect_batch(self.consumer, self.config.batch_size,
+                             self.config.batch_timeout_s)
 
     def _consume_loop(self, max_events, idle_timeout_s, idle_since,
                       checkpoint_and_ack, pending_acks) -> None:

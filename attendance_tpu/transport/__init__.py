@@ -52,6 +52,27 @@ def handle_poison(msg, consumer, metrics, config, logger, *,
         consumer.negative_acknowledge(msg)
 
 
+def collect_batch(consumer, batch_size: int, timeout_s: float) -> list:
+    """Fill a micro-batch from a consumer: up to ``batch_size`` messages,
+    or whatever arrived when ``timeout_s`` expires (partial batch).
+    Shared by every micro-batching consumer (processor, bridge) so the
+    partial-batch timeout rule has one definition."""
+    import time
+
+    msgs = []
+    deadline = time.monotonic() + timeout_s
+    while len(msgs) < batch_size:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0 and msgs:
+            break
+        try:
+            msgs.append(consumer.receive(
+                timeout_millis=max(1, int(max(remaining, 0) * 1000))))
+        except ReceiveTimeout:
+            break
+    return msgs
+
+
 def make_client(config):
     """Build the transport client selected by config.transport_backend."""
     if config.transport_backend == "memory":

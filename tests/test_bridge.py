@@ -1,0 +1,263 @@
+"""JSON->binary ingress bridge + native JSON scanner.
+
+Differential principle: the native schema scanner must be
+behavior-identical to the Python codec (decode_event ->
+columns_from_events) on everything it accepts, and must cleanly refuse
+anything it can't represent so the fallback produces the same result.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from attendance_tpu.config import Config
+from attendance_tpu.pipeline.events import (
+    AttendanceEvent, columns_from_events, decode_event,
+    decode_json_batch_columns)
+from attendance_tpu.transport.memory_broker import MemoryBroker, MemoryClient
+
+
+def _payload(**over):
+    d = {"student_id": 12345, "timestamp": "2026-03-02T09:15:00",
+         "lecture_id": "LECTURE_20260302", "is_valid": True,
+         "event_type": "entry"}
+    d.update(over)
+    return json.dumps(d).encode()
+
+
+def _python_columns(payloads):
+    return columns_from_events([decode_event(p) for p in payloads])
+
+
+def _assert_cols_equal(a, b):
+    for k in ("student_id", "lecture_day", "micros", "is_valid",
+              "event_type"):
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), k)
+
+
+FAST_SHAPES = [
+    _payload(),
+    _payload(event_type="exit", is_valid=False),
+    _payload(timestamp="2026-03-02 23:59:59"),            # space separator
+    _payload(timestamp="2026-03-02T09:15:00.25"),         # fraction
+    _payload(timestamp="2026-03-02T09:15:00.123456"),
+    _payload(timestamp="2026-03-02T09:15:00.1234567"),    # 7+ digits:
+    # fromisoformat truncates to 6; the scanner matches that exactly
+
+    _payload(student_id=0),
+    _payload(student_id=(1 << 32) - 1),
+    _payload(lecture_id="LECTURE_166123456"),             # 9-digit hash code
+    # key order permuted + extra unknown scalar keys + whitespace
+    b'{ "event_type" : "exit" , "gate": 7, "note": "x",\n'
+    b'"lecture_id":"LECTURE_20270101","is_valid":false,'
+    b'"timestamp":"2027-01-01T08:00:00","student_id":77 }',
+    # duplicate is_valid / event_type keys: json.loads keeps the LAST
+    # value; the scanner matches (regression: OR-accumulated
+    # first-true-wins diverged)
+    b'{"student_id": 5, "timestamp": "2026-03-02T09:15:00", '
+    b'"lecture_id": "LECTURE_20260302", "is_valid": true, '
+    b'"event_type": "exit", "is_valid": false, "event_type": "entry"}',
+]
+
+FALLBACK_SHAPES = [
+    _payload(lecture_id="PHYS101"),                       # needs murmur3
+    _payload(timestamp="2026-03-02T09:15:00+00:00"),      # tz suffix
+    _payload(lecture_id="LECT\\u0055RE_20260302"),        # escapes
+    _payload(lecture_id="LECTURE_caf\u00e9"),             # non-ASCII utf-8
+]
+
+
+def test_native_scanner_matches_python_codec():
+    from attendance_tpu.native import load as load_native
+    nat = load_native()
+    if nat is None:
+        pytest.skip("no C toolchain")
+    cols, miss = nat.parse_json_events(FAST_SHAPES)
+    assert miss == -1
+    _assert_cols_equal(cols, _python_columns(FAST_SHAPES))
+
+
+def test_native_scanner_refuses_fallback_shapes():
+    from attendance_tpu.native import load as load_native
+    nat = load_native()
+    if nat is None:
+        pytest.skip("no C toolchain")
+    for p in FALLBACK_SHAPES:
+        cols, miss = nat.parse_json_events([_payload(), p])
+        assert miss == 1, p
+        assert len(cols["student_id"]) == 1  # parsed prefix survives
+
+
+def test_decode_json_batch_columns_fallback_identical():
+    """Mixed batches route through the Python codec and still match."""
+    batch = FAST_SHAPES + FALLBACK_SHAPES
+    _assert_cols_equal(decode_json_batch_columns(batch),
+                       _python_columns(batch))
+
+
+def test_bridge_end_to_end_with_fused_pipeline():
+    """Reference-wire JSON producer -> bridge -> fused pipeline: the
+    stored events match the generator's ground truth exactly."""
+    from attendance_tpu.pipeline.bridge import JsonBinaryBridge
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.generator import generate_student_data
+
+    config = Config(transport_backend="memory", batch_size=256,
+                    bloom_filter_capacity=10_000)
+    broker = MemoryBroker()
+    bridge = JsonBinaryBridge(config, client=MemoryClient(broker))
+    pipe_cfg = Config(transport_backend="memory",
+                      pulsar_topic=bridge.out_topic,
+                      bloom_filter_capacity=10_000)
+    pipe = FusedPipeline(pipe_cfg, client=MemoryClient(broker),
+                         num_banks=16)
+
+    producer = MemoryClient(broker).create_producer(config.pulsar_topic)
+    report = generate_student_data(producer=producer, sketch_store=None,
+                                   num_students=80, num_invalid=8,
+                                   seed=13)
+    pipe.preload(np.asarray(sorted(report.valid_student_ids),
+                            dtype=np.uint32))
+
+    bridge.run(max_events=report.message_count, idle_timeout_s=0.5)
+    assert bridge.metrics.events == report.message_count
+    pipe.run(max_events=report.message_count, idle_timeout_s=0.5)
+
+    cols = pipe.store.to_columns(deduplicate=False)
+    assert len(cols["student_id"]) == report.message_count
+    truth = columns_from_events(report.events)
+    got_valid = np.asarray(cols["is_valid"], bool)
+    # no false negatives vs the generator's ground truth; FPR tiny
+    tv = np.asarray(truth["is_valid"], bool)
+    assert not (tv & ~got_valid).any()
+    assert (~tv & got_valid).sum() <= max(2, 0.02 * (~tv).sum())
+    np.testing.assert_array_equal(np.asarray(cols["student_id"]),
+                                  truth["student_id"])
+    np.testing.assert_array_equal(np.asarray(cols["micros"]),
+                                  truth["micros"])
+
+
+def test_bridge_dead_letters_poison_json():
+    from attendance_tpu.pipeline.bridge import JsonBinaryBridge
+
+    config = Config(transport_backend="memory", batch_size=8,
+                    batch_timeout_s=0.05, max_redeliveries=2)
+    broker = MemoryBroker()
+    bridge = JsonBinaryBridge(config, client=MemoryClient(broker))
+    producer = MemoryClient(broker).create_producer(config.pulsar_topic)
+    good = [_payload(student_id=i) for i in range(6)]
+    for p in good[:3]:
+        producer.send(p)
+    producer.send(b"{not json at all")
+    for p in good[3:]:
+        producer.send(p)
+    # No max_events: run to idle so the poison message exhausts its
+    # bounded redeliveries and dead-letters.
+    bridge.run(idle_timeout_s=1.0)
+    assert bridge.metrics.events == 6
+    assert bridge.metrics.dead_lettered == 1
+    # all six good events came out the binary side
+    sub = MemoryClient(broker).subscribe(bridge.out_topic, "check")
+    from attendance_tpu.pipeline.events import decode_binary_batch
+    total = 0
+    while True:
+        try:
+            msg = sub.receive(timeout_millis=100)
+        except Exception:
+            break
+        total += len(decode_binary_batch(msg.data())["student_id"])
+    assert total == 6
+
+
+def test_micros_exact_for_fractional_timestamps():
+    """_iso_to_micros is exact integer arithmetic: the old float
+    truncation (int(ts * 1e6)) lost 1 us on ~1% of fractional
+    timestamps, diverging from the native scanner."""
+    from attendance_tpu.pipeline.events import _iso_to_micros
+    assert _iso_to_micros("2040-07-11T15:13:45.869920") % 1_000_000 \
+        == 869920
+    # sweep: python == native for a spread of fractions
+    from attendance_tpu.native import load as load_native
+    nat = load_native()
+    if nat is None:
+        pytest.skip("no C toolchain")
+    payloads = [_payload(timestamp=f"2033-05-0{1 + i % 9}T0{i % 9}:"
+                         f"{10 + i % 50}:{10 + i % 50}.{f:06d}")
+                for i, f in enumerate(range(1, 999_983, 7919))]
+    cols, miss = nat.parse_json_events(payloads)
+    assert miss == -1
+    _assert_cols_equal(cols, _python_columns(payloads))
+
+
+REJECT_BOTH = [
+    # valid JSON the Python codec ALSO rejects; the native scanner must
+    # refuse them (miss) rather than silently accept
+    _payload(timestamp="2026-02-30T10:00:00"),   # nonexistent date
+    _payload(timestamp="2026-03-02T10:00:60"),   # leap second
+    b'{"student_id": 007, "timestamp": "2026-03-02T09:15:00", '
+    b'"lecture_id": "LECTURE_20260302", "is_valid": true, '
+    b'"event_type": "entry"}',                   # leading-zero int
+    _payload(timestamp="0000-01-01T00:00:00"),   # year < MINYEAR
+    # raw control character inside a string: json.loads rejects
+    b'{"student_id": 1, "timestamp": "2026-03-02T09:15:00", '
+    b'"lecture_id": "LECTURE\n_20260302", "is_valid": true, '
+    b'"event_type": "entry"}',
+    # trailing comma before }
+    b'{"student_id": 1, "timestamp": "2026-03-02T09:15:00", '
+    b'"lecture_id": "LECTURE_20260302", "is_valid": true, '
+    b'"event_type": "entry",}',
+    # bare-word / leading-zero unknown-key values
+    b'{"student_id": 1, "timestamp": "2026-03-02T09:15:00", '
+    b'"lecture_id": "LECTURE_20260302", "is_valid": true, '
+    b'"event_type": "entry", "gate": blah}',
+    b'{"student_id": 1, "timestamp": "2026-03-02T09:15:00", '
+    b'"lecture_id": "LECTURE_20260302", "is_valid": true, '
+    b'"event_type": "entry", "gate": 007}',
+]
+
+
+def test_native_never_accepts_what_python_rejects():
+    from attendance_tpu.native import load as load_native
+    nat = load_native()
+    if nat is None:
+        pytest.skip("no C toolchain")
+    for p in REJECT_BOTH:
+        with pytest.raises(Exception):
+            _python_columns([p])
+        cols, miss = nat.parse_json_events([p])
+        assert miss == 0, p
+
+
+def test_mixed_stream_keeps_native_segments():
+    """Fallback-shaped payloads are Python-parsed individually; the
+    native scan resumes for the conforming majority, and the combined
+    result equals the all-Python parse."""
+    batch = []
+    for i in range(50):
+        batch.append(_payload(student_id=i))
+        if i % 7 == 0:
+            batch.append(_payload(lecture_id="PHYS101", student_id=i))
+    _assert_cols_equal(decode_json_batch_columns(batch),
+                       _python_columns(batch))
+
+
+def test_bridge_dead_letters_valid_json_bad_timestamp():
+    """Valid JSON whose timestamp can't parse is poison too: it must
+    dead-letter through the bounded-retry policy, never crash the
+    bridge (which would redeliver-crash forever on restart)."""
+    from attendance_tpu.pipeline.bridge import JsonBinaryBridge
+
+    config = Config(transport_backend="memory", batch_size=8,
+                    batch_timeout_s=0.05, max_redeliveries=2)
+    broker = MemoryBroker()
+    bridge = JsonBinaryBridge(config, client=MemoryClient(broker))
+    producer = MemoryClient(broker).create_producer(config.pulsar_topic)
+    for i in range(3):
+        producer.send(_payload(student_id=i))
+    producer.send(_payload(timestamp="yesterday-ish"))
+    for i in range(3, 6):
+        producer.send(_payload(student_id=i))
+    bridge.run(idle_timeout_s=1.0)
+    assert bridge.metrics.events == 6
+    assert bridge.metrics.dead_lettered == 1
