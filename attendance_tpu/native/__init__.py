@@ -95,6 +95,10 @@ class HostPipe:
             _u8p, ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
             _u32p, _u32p, ctypes.POINTER(ctypes.c_int64), _u8p]
+        lib.atp_dedup_last.restype = ctypes.c_int64
+        lib.atp_dedup_last.argtypes = [
+            _u32p, _u32p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_size_t, _u32p]
 
     # -- column access helpers ----------------------------------------------
     @staticmethod
@@ -179,6 +183,23 @@ class HostPipe:
         miss = self.parse_json_from(b, 0)
         k = len(payloads) if miss < 0 else miss
         return b.columns(k), miss
+
+    def dedup_last(self, day: np.ndarray, sid: np.ndarray,
+                   micros: np.ndarray) -> Optional[np.ndarray]:
+        """Last-wins primary-key dedup over (day, micros, sid): returns
+        the kept rows' original indices in append order, or None when
+        the native pass can't run (allocation failure) — callers fall
+        back to the numpy lexsort. Inputs must be uint32/uint32/int64
+        contiguous (caller normalizes)."""
+        n = len(day)
+        out = np.empty(n, np.uint32)
+        kept = self._lib.atp_dedup_last(
+            _ptr(day, _u32p), _ptr(sid, _u32p),
+            micros.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, _ptr(out, _u32p))
+        if kept < 0:
+            return None
+        return out[:kept]
 
     def pack_bytes(self, keys: np.ndarray, days: np.ndarray,
                    lut: np.ndarray, day_base: int, bank_width: int,

@@ -79,19 +79,13 @@ class ColumnarEventStore:
         cols = {name: np.concatenate([np.asarray(b[name]) for b in blocks])
                 for name in _COLS}
         if deduplicate:
-            # Cassandra PK = (lecture, timestamp, student): last write wins.
-            # Stable lexsort with the append index as tiebreaker, then keep
-            # the final row of each equal-PK run.
-            n = len(cols["student_id"])
-            order = np.lexsort((np.arange(n), cols["student_id"],
-                                cols["micros"], cols["lecture_day"]))
-            day = cols["lecture_day"][order]
-            mic = cols["micros"][order]
-            sid = cols["student_id"][order]
-            last = np.ones(n, bool)
-            last[:-1] = ((day[1:] != day[:-1]) | (mic[1:] != mic[:-1])
-                         | (sid[1:] != sid[:-1]))
-            keep = np.sort(order[last])  # original append order
+            # Cassandra PK = (lecture, timestamp, student): last write
+            # wins. Fast path: the native host runtime's single-scan
+            # hash upsert (hostpipe.c atp_dedup_last — the numpy
+            # lexsort below runs ~0.8M rows/s at 50M rows, ~50x slower
+            # than the ingest it compacts). Both return the kept rows'
+            # original indices in append order.
+            keep = self._dedup_keep(cols)
             cols = {name: arr[keep] for name, arr in cols.items()}
         with self._lock:
             # Any concurrent mutation since the snapshot (insert, or a
@@ -101,6 +95,32 @@ class ColumnarEventStore:
             if self._write_gen == gen:
                 self._compacted[deduplicate] = cols
         return cols
+
+    @staticmethod
+    def _dedup_keep(cols: Dict[str, np.ndarray]) -> np.ndarray:
+        """Indices of the last row per primary key, ascending."""
+        from attendance_tpu.native import load as load_native
+
+        n = len(cols["student_id"])
+        nat = load_native()
+        if nat is not None:
+            # Day codes (< 2^28) and masked student ids (< 2^32) fit
+            # uint32; micros stays int64.
+            day = np.ascontiguousarray(cols["lecture_day"], np.uint32)
+            sid = np.ascontiguousarray(cols["student_id"], np.uint32)
+            mic = np.ascontiguousarray(cols["micros"], np.int64)
+            keep = nat.dedup_last(day, sid, mic)
+            if keep is not None:
+                return keep
+        order = np.lexsort((np.arange(n), cols["student_id"],
+                            cols["micros"], cols["lecture_day"]))
+        day = cols["lecture_day"][order]
+        mic = cols["micros"][order]
+        sid = cols["student_id"][order]
+        last = np.ones(n, bool)
+        last[:-1] = ((day[1:] != day[:-1]) | (mic[1:] != mic[:-1])
+                     | (sid[1:] != sid[:-1]))
+        return np.sort(order[last])  # original append order
 
     def to_dataframe(self, deduplicate: bool = True) -> pd.DataFrame:
         """DataFrame view of :meth:`to_columns` (compat / debugging)."""

@@ -261,3 +261,51 @@ def test_bridge_dead_letters_valid_json_bad_timestamp():
     bridge.run(idle_timeout_s=1.0)
     assert bridge.metrics.events == 6
     assert bridge.metrics.dead_lettered == 1
+
+
+def test_json_scanner_differential_fuzz():
+    """Randomized differential check of the native scanner's parity
+    contract: for arbitrary byte-mutated payloads, whenever the scanner
+    accepts, the Python codec must also accept AND produce identical
+    columns. (The converse — scanner bails, Python accepts — is the
+    designed fallback and always safe.)"""
+    import random
+
+    from attendance_tpu.native import load as load_native
+    nat = load_native()
+    if nat is None:
+        pytest.skip("no C toolchain")
+
+    rng = random.Random(0xA77E)
+    base = [
+        _payload(),
+        _payload(timestamp="2026-12-31 23:59:59.999999",
+                 lecture_id="LECTURE_166123456", event_type="exit"),
+        b'{ "event_type" : "exit", "extra": -1.5e3, "is_valid": false, '
+        b'"lecture_id":"LECTURE_20270101",'
+        b'"timestamp":"2027-01-01T08:00:00","student_id":77 }',
+    ]
+    mutations = 0
+    agree = 0
+    for trial in range(3000):
+        p = bytearray(rng.choice(base))
+        for _ in range(rng.randint(1, 3)):
+            op = rng.random()
+            pos = rng.randrange(len(p))
+            if op < 0.4:
+                p[pos] = rng.randrange(256)       # flip a byte
+            elif op < 0.7:
+                del p[pos]                        # drop a byte
+            else:
+                p.insert(pos, rng.randrange(32, 127))  # insert ascii
+        payload = bytes(p)
+        mutations += 1
+        cols, miss = nat.parse_json_events([payload])
+        if miss != -1:
+            continue  # scanner bailed: always safe
+        # scanner accepted: Python must agree bit-for-bit
+        ref = _python_columns([payload])
+        _assert_cols_equal(cols, ref)
+        agree += 1
+    # sanity: the fuzz actually exercised both outcomes
+    assert mutations == 3000 and 0 < agree < mutations

@@ -110,3 +110,39 @@ def test_columnar_row_adapter_preserves_lecture_ids():
     ])
     assert sorted(store.distinct_lecture_ids()) == [
         "LECTURE_20260302", "PHYS101"]
+
+
+def test_native_dedup_matches_numpy_lexsort():
+    """The native hash dedup and the numpy lexsort dedup must keep the
+    exact same rows (last write per primary key, append order)."""
+    import numpy as np
+    import pytest
+
+    from attendance_tpu.native import load as load_native
+    from attendance_tpu.storage.columnar_store import ColumnarEventStore
+
+    nat = load_native()
+    if nat is None:
+        pytest.skip("no C toolchain")
+    rng = np.random.default_rng(17)
+    n = 50_000
+    cols = {
+        "student_id": rng.integers(0, 500, n).astype(np.int64),
+        "lecture_day": rng.integers(20260101, 20260104, n
+                                    ).astype(np.int64),
+        # few distinct micros -> heavy duplication
+        "micros": rng.integers(0, 200, n).astype(np.int64) * 1_000_000,
+    }
+    native_keep = ColumnarEventStore._dedup_keep(cols)
+
+    order = np.lexsort((np.arange(n), cols["student_id"],
+                        cols["micros"], cols["lecture_day"]))
+    day = cols["lecture_day"][order]
+    mic = cols["micros"][order]
+    sid = cols["student_id"][order]
+    last = np.ones(n, bool)
+    last[:-1] = ((day[1:] != day[:-1]) | (mic[1:] != mic[:-1])
+                 | (sid[1:] != sid[:-1]))
+    numpy_keep = np.sort(order[last])
+    np.testing.assert_array_equal(np.asarray(native_keep, np.int64),
+                                  numpy_keep)
