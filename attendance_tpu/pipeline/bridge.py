@@ -32,7 +32,8 @@ from attendance_tpu.pipeline.events import (
     columns_from_events, decode_event, decode_json_batch_columns,
     encode_planar_batch)
 from attendance_tpu.pipeline.processor import ProcessorMetrics
-from attendance_tpu.transport import collect_batch, handle_poison, make_client
+from attendance_tpu.transport import (
+    acknowledge_all, collect_batch, handle_poison, make_client)
 
 logger = logging.getLogger(__name__)
 
@@ -84,8 +85,7 @@ class JsonBinaryBridge:
         self.producer.send(encode_planar_batch(cols))
         # Ack strictly after the binary frame is published: the bridge
         # never holds the only copy of an acknowledged event.
-        for m in good:
-            self.consumer.acknowledge(m)
+        acknowledge_all(self.consumer, good)
         self.metrics.batches += 1
         self.metrics.events += len(good)
         self.metrics.batch_sizes.append(len(good))

@@ -500,13 +500,14 @@ class FusedPipeline:
     def _checkpoint_and_ack(self) -> None:
         """Barrier: materialize all in-flight outputs, snapshot, then ack
         — every acknowledged frame is durably in the snapshot."""
+        from attendance_tpu.transport import acknowledge_all
+
         for _, valid in self._inflight:
             if valid is not None:
                 jax.block_until_ready(valid)
         self.snapshot()
-        while self._inflight:
-            msg, _ = self._inflight.popleft()
-            self.consumer.acknowledge(msg)
+        acknowledge_all(self.consumer, [msg for msg, _ in self._inflight])
+        self._inflight.clear()
 
     # -- ack draining -------------------------------------------------------
     def _drain_inflight(self, block: int = 0) -> None:

@@ -308,8 +308,8 @@ class AttendanceProcessor:
                         >= self._snap_every):
                     checkpoint_and_ack()
             else:
-                for m in good_msgs:
-                    self.consumer.acknowledge(m)
+                from attendance_tpu.transport import acknowledge_all
+                acknowledge_all(self.consumer, good_msgs)
             if max_events is not None and (
                     self.metrics.events >= max_events):
                 break
@@ -329,9 +329,10 @@ class AttendanceProcessor:
         pending_acks: List = []  # held until the next snapshot barrier
 
         def checkpoint_and_ack():
+            from attendance_tpu.transport import acknowledge_all
             self.snapshot()
-            while pending_acks:
-                self.consumer.acknowledge(pending_acks.pop())
+            acknowledge_all(self.consumer, pending_acks)
+            pending_acks.clear()
 
         try:
             with maybe_trace(self.config.profile_dir):

@@ -56,21 +56,43 @@ def collect_batch(consumer, batch_size: int, timeout_s: float) -> list:
     """Fill a micro-batch from a consumer: up to ``batch_size`` messages,
     or whatever arrived when ``timeout_s`` expires (partial batch).
     Shared by every micro-batching consumer (processor, bridge) so the
-    partial-batch timeout rule has one definition."""
+    partial-batch timeout rule has one definition.
+
+    Uses the consumer's batch receive when it has one (the memory
+    broker's receive_many drains pending messages under a single lock —
+    per-message receive() tops out ~0.25M msg/s on lock round-trips
+    alone); per-message receive is the fallback for clients without it
+    (the gated real-Pulsar wrapper)."""
     import time
 
+    batch_recv = getattr(consumer, "receive_many", None)
     msgs = []
     deadline = time.monotonic() + timeout_s
     while len(msgs) < batch_size:
         remaining = deadline - time.monotonic()
         if remaining <= 0 and msgs:
             break
+        timeout_ms = max(1, int(max(remaining, 0) * 1000))
         try:
-            msgs.append(consumer.receive(
-                timeout_millis=max(1, int(max(remaining, 0) * 1000))))
+            if batch_recv is not None:
+                msgs.extend(batch_recv(batch_size - len(msgs),
+                                       timeout_millis=timeout_ms))
+            else:
+                msgs.append(consumer.receive(timeout_millis=timeout_ms))
         except ReceiveTimeout:
             break
     return msgs
+
+
+def acknowledge_all(consumer, msgs) -> None:
+    """Ack a batch in one broker round-trip when the consumer supports
+    it; per-message otherwise."""
+    batch_ack = getattr(consumer, "acknowledge_many", None)
+    if batch_ack is not None:
+        batch_ack(msgs)
+        return
+    for m in msgs:
+        consumer.acknowledge(m)
 
 
 def make_client(config):
