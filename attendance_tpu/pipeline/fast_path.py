@@ -63,7 +63,8 @@ from attendance_tpu.models.hll import (
 from attendance_tpu.pipeline.events import decode_binary_batch
 from attendance_tpu.pipeline.processor import ProcessorMetrics
 from attendance_tpu.storage.columnar_store import ColumnarEventStore
-from attendance_tpu.transport import handle_poison, make_client
+from attendance_tpu.transport import (
+    acknowledge_all, handle_poison, make_client)
 from attendance_tpu.transport.memory_broker import ReceiveTimeout
 from attendance_tpu.utils.profiling import maybe_annotate, maybe_trace
 
@@ -500,8 +501,6 @@ class FusedPipeline:
     def _checkpoint_and_ack(self) -> None:
         """Barrier: materialize all in-flight outputs, snapshot, then ack
         — every acknowledged frame is durably in the snapshot."""
-        from attendance_tpu.transport import acknowledge_all
-
         for _, valid in self._inflight:
             if valid is not None:
                 jax.block_until_ready(valid)

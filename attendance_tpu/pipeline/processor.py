@@ -37,7 +37,8 @@ from attendance_tpu.utils.profiling import maybe_annotate, maybe_trace
 from attendance_tpu.sketch.base import ResponseError
 from attendance_tpu.storage import make_event_store
 from attendance_tpu.storage.memory_store import AttendanceRow
-from attendance_tpu.transport import handle_poison, make_client
+from attendance_tpu.transport import (
+    acknowledge_all, handle_poison, make_client)
 from attendance_tpu.transport.memory_broker import ReceiveTimeout
 
 logger = logging.getLogger(__name__)
@@ -308,7 +309,6 @@ class AttendanceProcessor:
                         >= self._snap_every):
                     checkpoint_and_ack()
             else:
-                from attendance_tpu.transport import acknowledge_all
                 acknowledge_all(self.consumer, good_msgs)
             if max_events is not None and (
                     self.metrics.events >= max_events):
@@ -329,7 +329,6 @@ class AttendanceProcessor:
         pending_acks: List = []  # held until the next snapshot barrier
 
         def checkpoint_and_ack():
-            from attendance_tpu.transport import acknowledge_all
             self.snapshot()
             acknowledge_all(self.consumer, pending_acks)
             pending_acks.clear()
