@@ -68,3 +68,57 @@ def test_analyze_loads_jsonl_into_columnar_flag(tmp_path, capsys):
           "--events-file", str(path)])
     out = capsys.readouterr().out
     assert "Habitual Latecomers" in out
+
+
+def test_generate_then_process_subcommands(capsys):
+    """The reference's two-process flow as CLI subcommands sharing the
+    in-process broker (generate -> process). The generator preloads its
+    own sketch store instance, so with hermetic memory backends the
+    processor recomputes validity against an empty filter — events all
+    flow, none validate (the single-process `pipeline` subcommand is
+    the shared-state hermetic path; real deployments share state via
+    the redis backend)."""
+    from attendance_tpu.transport.memory_broker import MemoryBroker
+
+    MemoryBroker.reset_shared()
+    try:
+        main(["generate", "--sketch-backend", "memory",
+              "--num-students", "20", "--num-invalid", "2",
+              "--seed", "5"])
+        main(["process", "--sketch-backend", "memory",
+              "--idle-timeout-s", "0.5"])
+    finally:
+        MemoryBroker.reset_shared()
+
+
+def test_bridge_subcommand(capsys):
+    """generate (JSON wire) -> bridge -> fused consuming the binary
+    topic, all through CLI entry points on the shared broker."""
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.transport import make_client
+    from attendance_tpu.transport.memory_broker import MemoryBroker
+
+    MemoryBroker.reset_shared()
+    try:
+        main(["generate", "--sketch-backend", "memory",
+              "--num-students", "15", "--num-invalid", "2",
+              "--seed", "8"])
+        main(["bridge", "--idle-timeout-s", "0.5"])
+        config = Config(transport_backend="memory",
+                        pulsar_topic="attendance-events-binary",
+                        bloom_filter_capacity=5_000)
+        pipe = FusedPipeline(config, client=make_client(config),
+                             num_banks=8)
+        pipe.run(idle_timeout_s=0.5)
+        assert pipe.metrics.events > 0
+    finally:
+        MemoryBroker.reset_shared()
+
+
+def test_parity_subcommand_exits_2_without_redis():
+    import pytest
+
+    with pytest.raises(SystemExit) as e:
+        main(["parity", "--num-events", "1000"])
+    assert e.value.code == 2
