@@ -1,0 +1,90 @@
+"""Randomized crash/restart soak (opt-in: ATP_SOAK=1).
+
+Repeatedly crashes a checkpointed pipeline at random progress points —
+random batch sizes, mesh shapes (single-chip and sharded), capacities,
+and snapshot cadences — and asserts the final store + PFCOUNTs always
+equal an uninterrupted reference run. Exercises the full
+at-least-once / idempotent-replay / snapshot-barrier story end to end
+(SURVEY.md §5); kept out of the default suite for runtime (~1 min).
+"""
+
+import os
+import shutil
+import tempfile
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("ATP_SOAK") != "1",
+    reason="soak test: set ATP_SOAK=1 to run")
+
+
+def test_randomized_crash_restart_soak():
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    rng = np.random.default_rng(123)
+    for cycle in range(6):
+        B = int(rng.choice([512, 1024, 2048]))
+        NF = int(rng.integers(6, 14))
+        sharded = bool(rng.random() < 0.5)
+        shards, reps = ((int(rng.choice([2, 4])), int(rng.choice([1, 2])))
+                        if sharded else (1, 1))
+        cap = int(rng.choice([10_000, 30_000]))
+        roster, frames = generate_frames(
+            B * NF, B, roster_size=cap // 2,
+            num_lectures=int(rng.integers(3, 9)),
+            seed=int(rng.integers(1e6)))
+        frames = list(frames)
+
+        def mkpipe(broker, snap=None):
+            cfg = Config(
+                bloom_filter_capacity=cap, transport_backend="memory",
+                num_shards=shards, num_replicas=reps,
+                snapshot_dir=snap or "",
+                snapshot_every_batches=(int(rng.integers(1, 4))
+                                        if snap else 0))
+            return FusedPipeline(cfg, client=MemoryClient(broker),
+                                 num_banks=8)
+
+        b0 = MemoryBroker()
+        ref = mkpipe(b0)
+        ref.preload(roster)
+        p0 = MemoryClient(b0).create_producer(ref.config.pulsar_topic)
+        for f in frames:
+            p0.send(f)
+        ref.run(max_events=B * NF, idle_timeout_s=0.5)
+        ref_counts = {d: ref.count(d) for d in ref.lecture_days()}
+        ref_cols = {k: np.sort(np.asarray(v))
+                    for k, v in ref.store.to_columns().items()}
+
+        snapdir = tempfile.mkdtemp()
+        try:
+            broker = MemoryBroker()
+            pr = MemoryClient(broker).create_producer(
+                ref.config.pulsar_topic)
+            for f in frames:
+                pr.send(f)
+            pipe = mkpipe(broker, snapdir)
+            pipe.preload(roster)
+            for _crash in range(int(rng.integers(1, 4))):
+                pipe.run(max_events=int(rng.integers(1, B * NF)),
+                         idle_timeout_s=0.4)
+                pipe.consumer.close()  # crash: unacked frames redeliver
+                pipe = mkpipe(broker, snapdir)  # restores snapshot
+            pipe.run(idle_timeout_s=0.8)
+            assert pipe.consumer.backlog() == 0
+            got_counts = {d: pipe.count(d) for d in pipe.lecture_days()}
+            assert got_counts == ref_counts, cycle
+            got_cols = {k: np.sort(np.asarray(v))
+                        for k, v in pipe.store.to_columns().items()}
+            assert (len(got_cols["student_id"])
+                    == len(ref_cols["student_id"])), cycle
+            for k in ("student_id", "lecture_day", "micros", "is_valid"):
+                assert np.array_equal(got_cols[k], ref_cols[k]), (cycle, k)
+        finally:
+            shutil.rmtree(snapdir, ignore_errors=True)
