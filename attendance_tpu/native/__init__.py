@@ -90,6 +90,12 @@ class HostPipe:
             ctypes.c_size_t, ctypes.c_size_t,
             _i32p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
             _u8p]
+        lib.atp_pack_seg.restype = ctypes.c_int64
+        lib.atp_pack_seg.argtypes = [
+            _u8p, ctypes.c_size_t, _u8p, ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_size_t,
+            _i32p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint32, _u32p, ctypes.c_size_t, _u32p]
         lib.atp_parse_json_events.restype = ctypes.c_int64
         lib.atp_parse_json_events.argtypes = [
             _u8p, ctypes.POINTER(ctypes.c_uint64),
@@ -130,6 +136,30 @@ class HostPipe:
         if rc == 0:
             return out, -1
         return None, int(rc - 1)
+
+    def pack_seg(self, keys: np.ndarray, days: np.ndarray,
+                 lut: np.ndarray, day_base: int, kb: int, padded: int,
+                 num_banks: int
+                 ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray], int]:
+        """Fused LUT map + segmented bit-pack (models.fused wire).
+        Returns (buf, perm, -1) on success, (None, None, miss_index) on
+        a LUT miss, or (None, None, -2) when the native pass can't run
+        (caller falls back to the numpy packer)."""
+        from attendance_tpu.models.fused import seg_buf_words
+
+        kp, ks = self._strided(keys)
+        db, ds = self._strided(days)
+        buf = np.empty(seg_buf_words(num_banks, kb, padded), np.uint32)
+        perm = np.empty(max(len(keys), 1), np.uint32)
+        rc = self._lib.atp_pack_seg(
+            kp, ks, db, ds, len(keys), padded, _ptr(lut, _i32p),
+            ctypes.c_uint32(day_base & 0xFFFFFFFF), len(lut), kb,
+            num_banks, _ptr(buf, _u32p), len(buf), _ptr(perm, _u32p))
+        if rc == 0:
+            return buf, perm[:len(keys)], -1
+        if rc < 0:
+            return None, None, -2
+        return None, None, int(rc - 1)
 
     def prepare_json_batch(self, payloads) -> "PreparedJsonBatch":
         """One-time O(total bytes) setup for a batch of JSON payloads;

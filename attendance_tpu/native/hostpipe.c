@@ -26,6 +26,8 @@
 
 #include <stddef.h>
 #include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
 
 /* Strided uint32 load: byte base + element index * byte stride. */
 static inline uint32_t ld_u32(const uint8_t *base, size_t i, size_t stride) {
@@ -116,6 +118,80 @@ int64_t atp_pack_bytes(const uint8_t *keys, size_t key_stride,
         for (size_t i = n; i < padded; ++i)
             ((uint32_t *)bv)[i] = 0xFFFFFFFFu;
     }
+    return 0;
+}
+
+/* Fused LUT bank-map + segmented bit-pack (the narrowest wire).
+ *
+ * Lays out ONE uint32 transfer buffer consumed by models.fused
+ * .fused_step_seg: [per-bank event counts u32[num_banks] | bitstream of
+ * kb bits per event, events stably sorted by bank | >= 2 guard words].
+ * The bank id itself never crosses the link — the device recovers it
+ * from the segment boundaries — so the wire is kb bits/event.
+ *
+ * out_perm[dst] = original index of the event packed at lane dst
+ * (counting sort, stable within each bank).  The caller permutes the
+ * store-bound columns with it so stored rows align with the device's
+ * validity vector.
+ *
+ * Returns 0 on success, 1 + i on the first LUT miss (same retry
+ * protocol as atp_pack_words), or -1 when scratch allocation fails /
+ * num_banks exceeds the u16 scratch encoding (caller falls back to the
+ * numpy packer).  buf_words is out_buf's uint32 length — the caller
+ * (native/__init__.py) sizes it with models.fused.seg_buf_words, the
+ * single definition of the wire layout; it is fully written here
+ * (counts + zeroed stream + OR-scattered key bits). */
+int64_t atp_pack_seg(const uint8_t *keys, size_t key_stride,
+                     const uint8_t *days, size_t day_stride,
+                     size_t n, size_t padded,
+                     const int32_t *lut, uint32_t day_base,
+                     uint32_t lut_size, uint32_t kb, uint32_t num_banks,
+                     uint32_t *out_buf, size_t buf_words,
+                     uint32_t *out_perm) {
+    if (num_banks > 0xFFFFu || kb == 0 || kb > 32) return -1;
+    /* The bitstream tail writes five bytes at bit (padded-1)*kb; make
+     * sure the caller's buffer really covers stream + guard. */
+    if (buf_words < num_banks + (padded * (size_t)kb + 31) / 32 + 2)
+        return -1;
+    uint16_t *bank_tmp = (uint16_t *)malloc(n * sizeof(uint16_t));
+    uint32_t *offsets = (uint32_t *)malloc(num_banks * sizeof(uint32_t));
+    if (!offsets || (n > 0 && !bank_tmp)) { /* offsets is always read */
+        free(bank_tmp); free(offsets);
+        return -1;
+    }
+    uint32_t *counts = out_buf;
+    memset(out_buf, 0, buf_words * sizeof(uint32_t));
+    for (size_t i = 0; i < n; ++i) {
+        uint32_t off = ld_u32(days, i, day_stride) - day_base;
+        if (off >= lut_size || lut[off] < 0) {
+            free(bank_tmp); free(offsets);
+            return 1 + (int64_t)i;
+        }
+        bank_tmp[i] = (uint16_t)lut[off];
+        ++counts[lut[off]];
+    }
+    uint32_t pos = 0;
+    for (uint32_t b = 0; b < num_banks; ++b) {
+        offsets[b] = pos;
+        pos += counts[b];
+    }
+    uint8_t *stream = (uint8_t *)(out_buf + num_banks);
+    for (size_t i = 0; i < n; ++i) {
+        uint32_t dst = offsets[bank_tmp[i]]++;
+        out_perm[dst] = (uint32_t)i;
+        uint64_t bit = (uint64_t)dst * kb;
+        uint64_t v = (uint64_t)ld_u32(keys, i, key_stride) << (bit & 7);
+        uint8_t *p = stream + (bit >> 3);
+        /* kb + 7 <= 39 bits: one unaligned u64 read-modify-write
+         * covers any span (memcpy compiles to plain movs); the guard
+         * words absorb the tail write.  Single-threaded, so the RMW on
+         * shared boundary bytes between events is safe. */
+        uint64_t cur;
+        memcpy(&cur, p, 8);
+        cur |= v;
+        memcpy(p, &cur, 8);
+    }
+    free(bank_tmp); free(offsets);
     return 0;
 }
 
@@ -437,9 +513,6 @@ int64_t atp_parse_json_events(const uint8_t *buf, const uint64_t *offs,
 /* ------------------------------------------------------------------ */
 /* Columnar-store compaction: last-wins primary-key dedup              */
 /* ------------------------------------------------------------------ */
-
-#include <stdlib.h>
-#include <string.h>
 
 /* The columnar store deduplicates on the Cassandra primary key
  * (lecture_day, micros, student_id), keeping the LAST appended row

@@ -57,7 +57,8 @@ from attendance_tpu.config import Config
 from attendance_tpu.models.bloom import bloom_add_packed
 from attendance_tpu.models.fused import (
     bank_wire_dtype, init_state, make_jitted_step_bytes,
-    make_jitted_step_words, pack_bytes, pack_words)
+    make_jitted_step_seg, make_jitted_step_words, pack_bytes, pack_seg,
+    pack_words)
 from attendance_tpu.models.hll import (
     best_histogram, estimate_from_histogram)
 from attendance_tpu.pipeline.events import decode_binary_batch
@@ -81,6 +82,33 @@ SKETCH_SNAPSHOT = "fused_sketch.npz"
 EVENTS_SNAPSHOT = "fused_events.npz"
 
 
+class _ScatterValidity:
+    """Lazy original-order view of the seg wire's permuted validity.
+
+    Holds the (possibly still in-flight) device vector plus the packed
+    lane -> original index permutation; materializes ``out[perm] = v``
+    only when a reader asks (store compaction, snapshot) — the hot loop
+    never pays the scatter, and the device sync stays as lazy as the
+    raw jax array the store keeps for the other wires.
+    """
+
+    __slots__ = ("_valid", "_perm", "_n")
+
+    def __init__(self, valid, perm, n: int):
+        self._valid, self._perm, self._n = valid, perm, n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __array__(self, dtype=None, copy=None):
+        v = np.asarray(self._valid)[:self._n]
+        out = np.empty(self._n, v.dtype)
+        out[self._perm] = v
+        if dtype is not None and np.dtype(dtype) != out.dtype:
+            out = out.astype(dtype)
+        return out
+
+
 class FusedPipeline:
     SUBSCRIPTION = "attendance_fused"
 
@@ -95,6 +123,11 @@ class FusedPipeline:
         self.sharded = (self.config.num_shards
                         * self.config.num_replicas) > 1
         if self.sharded:
+            if self.config.wire_format != "auto":
+                logger.warning(
+                    "--wire-format=%s has no effect with num_shards/"
+                    "num_replicas > 1: the sharded engine uses its own "
+                    "mesh transfer layout", self.config.wire_format)
             from attendance_tpu.parallel.multihost import (
                 init_distributed, make_multihost_mesh)
             from attendance_tpu.parallel.sharded import ShardedSketchEngine
@@ -130,6 +163,9 @@ class FusedPipeline:
             # width; _kw_hint grows monotonically so a stable key
             # population compiles at most a couple of widths.
             self._word_steps: Dict[int, object] = {}
+            # Segmented bit-packed (kb bits/event) step programs, one
+            # per (key width, padded shape, bank count).
+            self._seg_steps: Dict[tuple, object] = {}
             self._kw_hint = 1
             # Native host runtime (fused decode+LUT+pack pass); None
             # falls back to the numpy path transparently. _native_skip
@@ -281,14 +317,24 @@ class FusedPipeline:
             banks = self._banks_for(cols["lecture_day"])
             with maybe_annotate(self._profiling, "sharded_fused_step"):
                 valid_n = self.engine.step(cols["student_id"], banks)
+            stored = valid_n
         else:
             padded = 256
             while padded < n:
                 padded *= 2
             with maybe_annotate(self._profiling, "fused_step_dispatch"):
-                valid = self._dispatch_single(cols, n, padded)
+                valid, perm = self._dispatch_single(cols, n, padded)
             valid_n = valid[:n]
-        self.store.insert_columns({**cols, "is_valid": valid_n})
+            # Segmented wire: the device answered in bank-sorted order.
+            # Rows are stored in ORIGINAL order with a lazy validity
+            # view that scatters the permuted vector back at read time —
+            # compaction is off the hot path, and a per-frame host
+            # gather of every column here measurably erases the narrow
+            # wire's win. The jax slice (not the wrapper) is what flows
+            # back to the ack chain, which probes .is_ready() on it.
+            stored = (valid_n if perm is None
+                      else _ScatterValidity(valid, perm, n))
+        self.store.insert_columns({**cols, "is_valid": stored})
         self.metrics.batches += 1
         self.metrics.events += n
         self.metrics.batch_sizes.append(n)
@@ -300,6 +346,15 @@ class FusedPipeline:
         if step is None:
             step = self._word_steps[kw] = make_jitted_step_words(
                 self.params, kw, self.config.hll_precision)
+        return step
+
+    def _seg_step(self, kb: int, padded: int, num_banks: int):
+        key = (kb, padded, num_banks)
+        step = self._seg_steps.get(key)
+        if step is None:
+            step = self._seg_steps[key] = make_jitted_step_seg(
+                self.params, kb, padded, num_banks,
+                self.config.hll_precision)
         return step
 
     def _pick_kw(self, frame_bits: int, num_banks: int) -> int:
@@ -314,14 +369,20 @@ class FusedPipeline:
 
     def _dispatch_single(self, cols: Dict[str, np.ndarray], n: int,
                          padded: int):
-        """Pack one frame's (key, bank) lanes and dispatch the fused step.
+        """Pack one frame's (key, bank) lanes and dispatch the fused
+        step; returns (valid, perm) where perm is the packed-lane ->
+        original-index permutation of the segmented wire, or None for
+        the order-preserving wires.
 
         Wire format choice: the sustained host->device link rate is the
         e2e ceiling (measured ~130 MB/s steady on the relay tunnel), so
-        bytes/event is directly events/sec. Preferred wire is ONE uint32
-        word per event — bank id folded into the key's spare high bits
-        (4 bytes/event); the 5-byte key+bank wire is the fallback when
-        key and bank bits don't fit one word together.
+        bytes/event is directly events/sec. Narrowest first: the
+        bank-SEGMENTED bit-packed stream (kb bits/event — the bank id
+        never crosses the link; config.wire_format "auto" uses it
+        whenever the native host runtime is up, "seg" forces it through
+        the numpy packer too); then ONE uint32 word per event — bank id
+        folded into the key's spare high bits (4 bytes/event); then the
+        5-byte key+bank wire when key and bank bits don't fit one word.
 
         The pack itself runs in the native host runtime when available
         (one fused max-scan + LUT-map + pack pass, hostpipe.c); the
@@ -344,13 +405,27 @@ class FusedPipeline:
             # day population shifted back to the dense window.
             self._native_skip -= 1
             nat = None
+        wire = self.config.wire_format
+        if wire == "seg" or (wire == "auto" and nat is not None):
+            valid, perm, banks = self._dispatch_seg(
+                cols, n, padded, nat, forced=wire == "seg")
+            if valid is not None:
+                return valid, perm
+            # Seg wire unavailable for this frame (native bypass armed,
+            # or a native allocation failure in auto mode): the legacy
+            # wires below carry it, skipping the already-doomed native
+            # attempt and reusing any banks the seg attempt resolved
+            # (bank growth there also means num_banks must be re-read).
+            nat = None
+            num_banks = self.state.hll_regs.shape[0]
         if nat is not None:
             if self._day_base is None:
                 self._rebuild_lut(int(days.min()))
             frame_bits = nat.max_key(sid).bit_length()
             for _attempt in (0, 1):
                 kw = self._pick_kw(frame_bits, num_banks)
-                use_words = kw + num_banks.bit_length() <= 32
+                use_words = (kw + num_banks.bit_length() <= 32
+                             and wire != "bytes")
                 if use_words:
                     words, miss = nat.pack_words(
                         sid, days, self._day_lut, self._day_base, kw,
@@ -367,7 +442,7 @@ class FusedPipeline:
                     else:
                         self.state, valid = self._step(
                             self.state, jax.numpy.asarray(words))
-                    return valid
+                    return valid, None
                 if _attempt == 1:
                     # Missed again after full registration: this frame
                     # has a day the dense LUT cannot cover. Bypass
@@ -397,18 +472,78 @@ class FusedPipeline:
             banks = self._banks_for(days)
             num_banks = self.state.hll_regs.shape[0]
         kw = self._pick_kw(int(sid.max()).bit_length(), num_banks)
-        if kw + num_banks.bit_length() <= 32:
+        if kw + num_banks.bit_length() <= 32 and wire != "bytes":
             self._kw_hint = kw
             words = pack_words(sid, banks, kw, padded)
             self.state, valid = self._word_step(kw)(
                 self.state, jax.numpy.asarray(words))
-            return valid
+            return valid, None
         # ONE combined byte-packed transfer: B little-endian uint32
         # keys then B narrow bank ids (dtype max = padded lane) —
         # (4 + w) bytes/event on the link instead of 8.
         buf = pack_bytes(sid, banks, self._bank_dtype, padded)
         self.state, valid = self._step(self.state, jax.numpy.asarray(buf))
-        return valid
+        return valid, None
+
+    def _dispatch_seg(self, cols: Dict[str, np.ndarray], n: int,
+                      padded: int, nat, forced: bool):
+        """Segmented-wire dispatch; returns (valid, perm, None) on
+        success, or (None, None, banks_or_None) when this frame should
+        fall back to the legacy wires (auto mode only: native bypass
+        armed by persistent out-of-LUT-window days, or a native
+        scratch-allocation failure) — banks carries any day->bank
+        resolution already done so the caller doesn't resolve twice."""
+        sid, days = cols["student_id"], cols["lecture_day"]
+        num_banks = self.state.hll_regs.shape[0]
+        banks = None
+        if nat is not None:
+            if self._day_base is None:
+                self._rebuild_lut(int(days.min()))
+            frame_bits = nat.max_key(sid).bit_length()
+            for _attempt in (0, 1):
+                kb = min(max(frame_bits, 1, self._kw_hint), 32)
+                buf, perm, miss = nat.pack_seg(
+                    sid, days, self._day_lut, self._day_base, kb,
+                    padded, num_banks)
+                if miss == -1:
+                    self._kw_hint = kb
+                    self.state, valid = self._seg_step(
+                        kb, padded, num_banks)(
+                            self.state, jax.numpy.asarray(buf))
+                    return valid, perm, None
+                if miss == -2:  # scratch alloc failed / too many banks
+                    if not forced:
+                        return None, None, banks
+                    break
+                if _attempt == 1:
+                    # Missed again after full registration: persistent
+                    # out-of-LUT-window days (see _dispatch_single).
+                    self._native_skip = 32
+                    if not forced:
+                        return None, None, banks
+                    break
+                banks = self._banks_for(days)
+                num_banks = self.state.hll_regs.shape[0]
+                off = int(days[miss]) - self._day_base
+                if not (0 <= off < self._LUT_SIZE
+                        and self._day_lut[off] >= 0):
+                    self._native_skip = 32
+                    if not forced:
+                        return None, None, banks
+                    break
+        # numpy packer: forced seg mode without (or past) the native
+        # runtime. argsort-based — correct for any day population, but
+        # slower than the fused native pass; auto mode prefers the
+        # legacy wires in that situation.
+        if banks is None:
+            banks = self._banks_for(days)
+            num_banks = self.state.hll_regs.shape[0]
+        kb = min(max(int(sid.max()).bit_length(), 1, self._kw_hint), 32)
+        self._kw_hint = kb
+        buf, perm = pack_seg(sid, banks, kb, padded, num_banks)
+        self.state, valid = self._seg_step(kb, padded, num_banks)(
+            self.state, jax.numpy.asarray(buf))
+        return valid, perm, None
 
     # -- checkpointing ------------------------------------------------------
     @property
