@@ -94,11 +94,13 @@ class Config:
     # the processing run (TensorBoard/XProf-loadable). Device dispatches
     # are TraceAnnotation-labelled so kernel time attributes to stages.
     profile_dir: str = ""
-    # Wire format for the fused pipeline's host->device transfer:
-    # "auto" picks the narrowest applicable wire (the bank-segmented
-    # bit-packed stream when the native host runtime is available, else
-    # the word wire); "seg"/"word"/"bytes" force one. The link is the
-    # measured e2e bottleneck, so bytes/event is directly events/sec.
+    # Wire format for the fused pipeline's host->device transfer.
+    # Either the link or the host-side pack is the e2e bottleneck,
+    # depending on the moment's link rate vs host load; "auto" starts
+    # at the cheap word wire and adapts per frame from observed
+    # backpressure (narrowing word->seg->delta when the device side
+    # falls behind — see fast_path._auto_wire; requires the native
+    # host runtime to narrow). "delta"/"seg"/"word"/"bytes" force one.
     wire_format: str = "auto"
     # Poison-message handling: a frame that fails decode/processing is
     # nacked for redelivery at most this many times, then dead-lettered
@@ -114,7 +116,8 @@ class Config:
             raise ValueError(f"unknown bloom layout: {self.bloom_layout}")
         if not (4 <= self.hll_precision <= 18):
             raise ValueError(f"hll precision out of range: {self.hll_precision}")
-        if self.wire_format not in ("auto", "seg", "word", "bytes"):
+        if self.wire_format not in ("auto", "delta", "seg", "word",
+                                    "bytes"):
             raise ValueError(f"unknown wire format: {self.wire_format}")
         if self.replica_sync not in ("step", "query"):
             raise ValueError(f"unknown replica sync: {self.replica_sync}")
@@ -165,9 +168,11 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
     p.add_argument("--snapshot-dir", default=d.snapshot_dir)
     p.add_argument("--snapshot-every-batches", type=int,
                    default=d.snapshot_every_batches)
-    p.add_argument("--wire-format", choices=["auto", "seg", "word", "bytes"],
+    p.add_argument("--wire-format",
+                   choices=["auto", "delta", "seg", "word", "bytes"],
                    default=d.wire_format,
-                   help="fused-path host->device wire (auto = narrowest)")
+                   help="fused-path host->device wire (auto adapts "
+                   "word->seg->delta from observed backpressure)")
     p.add_argument("--max-redeliveries", type=int, default=d.max_redeliveries)
     p.add_argument("--profile-dir", default=d.profile_dir,
                    help="write a jax.profiler trace of the run here")

@@ -96,6 +96,16 @@ class HostPipe:
             ctypes.c_size_t, ctypes.c_size_t,
             _i32p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
             ctypes.c_uint32, _u32p, ctypes.c_size_t, _u32p]
+        lib.atp_delta_scan.restype = ctypes.c_int64
+        lib.atp_delta_scan.argtypes = [
+            _u8p, ctypes.c_size_t, _u8p, ctypes.c_size_t,
+            ctypes.c_size_t,
+            _i32p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+            _u32p, _u32p, _u32p, _u32p, _u32p]
+        lib.atp_bitpack.restype = ctypes.c_int64
+        lib.atp_bitpack.argtypes = [
+            _u32p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_uint32,
+            _u32p, ctypes.c_size_t]
         lib.atp_parse_json_events.restype = ctypes.c_int64
         lib.atp_parse_json_events.argtypes = [
             _u8p, ctypes.POINTER(ctypes.c_uint64),
@@ -160,6 +170,47 @@ class HostPipe:
         if rc < 0:
             return None, None, -2
         return None, None, int(rc - 1)
+
+    def pack_delta(self, keys: np.ndarray, days: np.ndarray,
+                   lut: np.ndarray, day_base: int, db_hint: int,
+                   padded: int, num_banks: int):
+        """Fused LUT map + (bank, key) sort + delta emit + bit-pack
+        (models.fused delta wire). Returns (buf, perm, db, -1) on
+        success — db is max(db_hint, the frame's needed width) — or
+        (None, None, 0, miss_index) on a LUT miss / (None, None, 0, -2)
+        when the native pass can't run."""
+        from attendance_tpu.models.fused import delta_buf_words
+
+        kp, ks = self._strided(keys)
+        dp, ds = self._strided(days)
+        n = len(keys)
+        counts = np.empty(num_banks, np.uint32)
+        bases = np.empty(num_banks, np.uint32)
+        deltas = np.empty(max(n, 1), np.uint32)
+        perm = np.empty(max(n, 1), np.uint32)
+        needed = np.zeros(1, np.uint32)
+        rc = self._lib.atp_delta_scan(
+            kp, ks, dp, ds, n, _ptr(lut, _i32p),
+            ctypes.c_uint32(day_base & 0xFFFFFFFF), len(lut), num_banks,
+            _ptr(counts, _u32p), _ptr(bases, _u32p), _ptr(deltas, _u32p),
+            _ptr(perm, _u32p), _ptr(needed, _u32p))
+        if rc > 0:
+            return None, None, 0, int(rc - 1)
+        if rc < 0:
+            return None, None, 0, -2
+        from attendance_tpu.models.fused import pick_delta_width
+
+        db = pick_delta_width(db_hint, int(needed[0]))
+        buf = np.empty(delta_buf_words(num_banks, db, padded), np.uint32)
+        buf[:num_banks] = counts
+        buf[num_banks:2 * num_banks] = bases
+        rc = self._lib.atp_bitpack(
+            _ptr(deltas, _u32p), n, padded, db,
+            _ptr(buf[2 * num_banks:], _u32p),
+            len(buf) - 2 * num_banks)
+        if rc < 0:
+            return None, None, 0, -2
+        return buf, perm[:n], db, -1
 
     def prepare_json_batch(self, payloads) -> "PreparedJsonBatch":
         """One-time O(total bytes) setup for a batch of JSON payloads;

@@ -29,6 +29,27 @@
 #include <stdlib.h>
 #include <string.h>
 
+/* Per-thread scratch arena: the packers need several MB of working
+ * memory per frame, and a fresh malloc each call costs more in page
+ * faults than the passes that use it (measured ~5ms of a 7ms 512k-event
+ * delta scan).  Slots grow monotonically and are never freed, so the
+ * bound is PER THREAD (largest frame that thread ever packs) and the
+ * arena leaks when its thread exits — callers that pack from
+ * short-lived worker threads should pack from a long-lived one
+ * instead (the pipeline drives all packs from its run loop thread). */
+enum { SCRATCH_SLOTS = 6 };
+static __thread struct { void *p; size_t cap; } g_scratch[SCRATCH_SLOTS];
+
+static void *scratch(int slot, size_t bytes) {
+    if (g_scratch[slot].cap < bytes) {
+        void *np_ = realloc(g_scratch[slot].p, bytes);
+        if (!np_) return NULL;
+        g_scratch[slot].p = np_;
+        g_scratch[slot].cap = bytes;
+    }
+    return g_scratch[slot].p;
+}
+
 /* Strided uint32 load: byte base + element index * byte stride. */
 static inline uint32_t ld_u32(const uint8_t *base, size_t i, size_t stride) {
     const uint8_t *p = base + i * stride;
@@ -153,20 +174,18 @@ int64_t atp_pack_seg(const uint8_t *keys, size_t key_stride,
      * sure the caller's buffer really covers stream + guard. */
     if (buf_words < num_banks + (padded * (size_t)kb + 31) / 32 + 2)
         return -1;
-    uint16_t *bank_tmp = (uint16_t *)malloc(n * sizeof(uint16_t));
-    uint32_t *offsets = (uint32_t *)malloc(num_banks * sizeof(uint32_t));
-    if (!offsets || (n > 0 && !bank_tmp)) { /* offsets is always read */
-        free(bank_tmp); free(offsets);
+    uint16_t *bank_tmp = (uint16_t *)scratch(0, (n ? n : 1)
+                                             * sizeof(uint16_t));
+    uint32_t *offsets = (uint32_t *)scratch(1, num_banks
+                                            * sizeof(uint32_t));
+    if (!bank_tmp || !offsets)
         return -1;
-    }
     uint32_t *counts = out_buf;
     memset(out_buf, 0, buf_words * sizeof(uint32_t));
     for (size_t i = 0; i < n; ++i) {
         uint32_t off = ld_u32(days, i, day_stride) - day_base;
-        if (off >= lut_size || lut[off] < 0) {
-            free(bank_tmp); free(offsets);
+        if (off >= lut_size || lut[off] < 0)
             return 1 + (int64_t)i;
-        }
         bank_tmp[i] = (uint16_t)lut[off];
         ++counts[lut[off]];
     }
@@ -191,7 +210,161 @@ int64_t atp_pack_seg(const uint8_t *keys, size_t key_stride,
         cur |= v;
         memcpy(p, &cur, 8);
     }
-    free(bank_tmp); free(offsets);
+    return 0;
+}
+
+/* Delta wire scan: sort by (bank, key) and emit the per-event deltas.
+ *
+ * Stable order: a counting sort by bank over the original order, then
+ * an LSD byte-radix by key within each bank segment — equal (bank,
+ * key) events keep append order, which is what keeps the columnar
+ * store's last-write-wins ties identical across wires.  Outputs the
+ * per-bank counts and base (first, smallest) keys, the delta stream
+ * (0 at each segment start; the base rides in the header), the packed
+ * lane -> original index permutation, and the widest delta's bit
+ * count via *out_needed (the caller picks the wire width from it and
+ * bit-packs with atp_bitpack).
+ *
+ * Returns 0 on success, 1 + i on the first LUT miss, -1 when scratch
+ * allocation fails or num_banks exceeds the u16 scratch encoding. */
+int64_t atp_delta_scan(const uint8_t *keys, size_t key_stride,
+                       const uint8_t *days, size_t day_stride,
+                       size_t n,
+                       const int32_t *lut, uint32_t day_base,
+                       uint32_t lut_size, uint32_t num_banks,
+                       uint32_t *out_counts, uint32_t *out_bases,
+                       uint32_t *out_deltas, uint32_t *out_perm,
+                       uint32_t *out_needed) {
+    if (num_banks > 0xFFFFu) return -1;
+    uint16_t *bank_tmp = (uint16_t *)scratch(0, (n ? n : 1)
+                                             * sizeof(uint16_t));
+    uint32_t *offsets = (uint32_t *)scratch(1, num_banks
+                                            * sizeof(uint32_t));
+    /* skey holds the keys in bank order, then in (bank, key) order;
+     * tkey/tidx are the radix ping-pong. */
+    uint32_t *skey = (uint32_t *)scratch(2, (n ? n : 1)
+                                         * sizeof(uint32_t));
+    uint32_t *tkey = (uint32_t *)scratch(3, (n ? n : 1)
+                                         * sizeof(uint32_t));
+    uint32_t *tidx = (uint32_t *)scratch(4, (n ? n : 1)
+                                         * sizeof(uint32_t));
+    if (!bank_tmp || !offsets || !skey || !tkey || !tidx)
+        return -1;
+    memset(out_counts, 0, num_banks * sizeof(uint32_t));
+    uint32_t maxkey = 0;
+    for (size_t i = 0; i < n; ++i) {
+        uint32_t off = ld_u32(days, i, day_stride) - day_base;
+        if (off >= lut_size || lut[off] < 0)
+            return 1 + (int64_t)i;
+        bank_tmp[i] = (uint16_t)lut[off];
+        ++out_counts[lut[off]];
+        uint32_t k = ld_u32(keys, i, key_stride);
+        if (k > maxkey) maxkey = k;
+    }
+    uint32_t pos = 0;
+    for (uint32_t b = 0; b < num_banks; ++b) {
+        offsets[b] = pos;
+        pos += out_counts[b];
+    }
+    for (size_t i = 0; i < n; ++i) {
+        uint32_t dst = offsets[bank_tmp[i]]++;
+        skey[dst] = ld_u32(keys, i, key_stride);
+        out_perm[dst] = (uint32_t)i;
+    }
+    /* offsets[b] is now each segment's END.  Radix-sort each segment
+     * by key: 11-bit digits (2 passes cover 22-bit ids, 3 cover u32),
+     * one combined histogram sweep for every digit, ping-pong buffers
+     * with at most one final copy.  Stable, so equal keys keep append
+     * order. */
+    enum { DBITS = 11, DSIZE = 1 << DBITS, DMASK = DSIZE - 1 };
+    int digits = 0;
+    while ((maxkey >> (DBITS * digits)) != 0 && digits < 3) ++digits;
+    uint32_t *hist = (uint32_t *)scratch(5, 3 * DSIZE * sizeof(uint32_t));
+    if (!hist)
+        return -1;
+    uint32_t seg_start = 0;
+    for (uint32_t b = 0; b < num_banks; ++b) {
+        uint32_t seg_end = offsets[b];
+        size_t m = seg_end - seg_start;
+        uint32_t *sk = skey + seg_start, *si = out_perm + seg_start;
+        if (m > 1 && digits > 0) {
+            memset(hist, 0, digits * DSIZE * sizeof(uint32_t));
+            for (size_t i = 0; i < m; ++i) {
+                uint32_t k = sk[i];
+                ++hist[k & DMASK];
+                if (digits > 1) ++hist[DSIZE + ((k >> DBITS) & DMASK)];
+                if (digits > 2) ++hist[2 * DSIZE + (k >> (2 * DBITS))];
+            }
+            uint32_t *ak = sk, *ai = si, *bk = tkey, *bi = tidx;
+            for (int d = 0; d < digits; ++d) {
+                uint32_t *h = hist + d * DSIZE;
+                int shift = DBITS * d;
+                if (h[(ak[0] >> shift) & DMASK] == m)
+                    continue; /* uniform digit: nothing to move */
+                uint32_t p = 0;
+                for (int v = 0; v < DSIZE; ++v) {
+                    uint32_t c = h[v];
+                    h[v] = p;
+                    p += c;
+                }
+                for (size_t i = 0; i < m; ++i) {
+                    uint32_t dst = h[(ak[i] >> shift) & DMASK]++;
+                    bk[dst] = ak[i];
+                    bi[dst] = ai[i];
+                }
+                uint32_t *t = ak; ak = bk; bk = t;
+                t = ai; ai = bi; bi = t;
+            }
+            if (ak != sk) {
+                memcpy(sk, ak, m * sizeof(uint32_t));
+                memcpy(si, ai, m * sizeof(uint32_t));
+            }
+        }
+        out_bases[b] = m ? sk[0] : 0;
+        seg_start = seg_end;
+    }
+    uint32_t maxd = 0;
+    seg_start = 0;
+    for (uint32_t b = 0; b < num_banks; ++b) {
+        uint32_t seg_end = offsets[b];
+        if (seg_end > seg_start) {
+            out_deltas[seg_start] = 0;
+            for (uint32_t i = seg_start + 1; i < seg_end; ++i) {
+                uint32_t d = skey[i] - skey[i - 1];
+                out_deltas[i] = d;
+                if (d > maxd) maxd = d;
+            }
+        }
+        seg_start = seg_end;
+    }
+    int bits = 0;
+    while ((maxd >> bits) != 0) ++bits;
+    *out_needed = bits ? (uint32_t)bits : 1;
+    return 0;
+}
+
+/* Sequential fixed-width bit-pack of the delta stream (zeroed padding
+ * tail).  Accumulator-based — no read-modify-writes, ~2 ops/event.
+ * stream_words must be >= (padded*db + 31)/32 + 2 guard words. */
+int64_t atp_bitpack(const uint32_t *vals, size_t n, size_t padded,
+                    uint32_t db, uint32_t *stream, size_t stream_words) {
+    if (db == 0 || db > 32) return -1;
+    if (stream_words < (padded * (size_t)db + 31) / 32 + 2) return -1;
+    uint64_t acc = 0;
+    int nbits = 0;
+    size_t w = 0;
+    for (size_t i = 0; i < n; ++i) {
+        acc |= (uint64_t)vals[i] << nbits;
+        nbits += (int)db;
+        while (nbits >= 32) {
+            stream[w++] = (uint32_t)acc;
+            acc >>= 32;
+            nbits -= 32;
+        }
+    }
+    if (nbits > 0)
+        stream[w++] = (uint32_t)acc;
+    memset(stream + w, 0, (stream_words - w) * sizeof(uint32_t));
     return 0;
 }
 

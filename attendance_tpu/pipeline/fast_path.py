@@ -57,8 +57,9 @@ from attendance_tpu.config import Config
 from attendance_tpu.models.bloom import bloom_add_packed
 from attendance_tpu.models.fused import (
     bank_wire_dtype, init_state, make_jitted_step_bytes,
-    make_jitted_step_seg, make_jitted_step_words, pack_bytes, pack_seg,
-    pack_words)
+    make_jitted_step_delta, make_jitted_step_seg, make_jitted_step_words,
+    delta_scan, pack_bytes, pack_delta, pack_seg, pack_words,
+    pick_delta_width)
 from attendance_tpu.models.hll import (
     best_histogram, estimate_from_histogram)
 from attendance_tpu.pipeline.events import decode_binary_batch
@@ -166,7 +167,22 @@ class FusedPipeline:
             # Segmented bit-packed (kb bits/event) step programs, one
             # per (key width, padded shape, bank count).
             self._seg_steps: Dict[tuple, object] = {}
+            # Delta-coded (db bits/event) step programs. The delta
+            # width is data-dependent (the frame's widest sorted-key
+            # gap), so _db_hint grows monotonically and widths round up
+            # to even values — a stable population compiles a couple of
+            # programs, not one per frame.
+            self._delta_steps: Dict[tuple, object] = {}
+            self._db_hint = 1
             self._kw_hint = 1
+            # Adaptive wire ladder for auto mode (see _auto_wire):
+            # 0 = word (cheapest host pack), 1 = seg, 2 = delta
+            # (narrowest link). Which resource binds depends on the
+            # moment's link rate vs host contention, so auto adapts
+            # per frame from observed backpressure instead of
+            # committing to either.
+            self._auto_level = 0
+            self._auto_pressure = 0
             # Native host runtime (fused decode+LUT+pack pass); None
             # falls back to the numpy path transparently. _native_skip
             # adaptively bypasses doomed native attempts when the
@@ -357,6 +373,15 @@ class FusedPipeline:
                 self.config.hll_precision)
         return step
 
+    def _delta_step(self, db: int, padded: int, num_banks: int):
+        key = (db, padded, num_banks)
+        step = self._delta_steps.get(key)
+        if step is None:
+            step = self._delta_steps[key] = make_jitted_step_delta(
+                self.params, db, padded, num_banks,
+                self.config.hll_precision)
+        return step
+
     def _pick_kw(self, frame_bits: int, num_banks: int) -> int:
         """Key width for the word wire: the frame's own max-key bits,
         widened to the monotonic hint (fewer distinct compiled widths) —
@@ -374,14 +399,14 @@ class FusedPipeline:
         original-index permutation of the segmented wire, or None for
         the order-preserving wires.
 
-        Wire format choice: the sustained host->device link rate is the
-        e2e ceiling (measured ~130 MB/s steady on the relay tunnel), so
-        bytes/event is directly events/sec. Narrowest first: the
-        bank-SEGMENTED bit-packed stream (kb bits/event — the bank id
-        never crosses the link; config.wire_format "auto" uses it
-        whenever the native host runtime is up, "seg" forces it through
-        the numpy packer too); then ONE uint32 word per event — bank id
-        folded into the key's spare high bits (4 bytes/event); then the
+        Wire format choice: either the host->device link or the host
+        pack is the e2e ceiling, and which one varies with link weather
+        (see _auto_wire — config.wire_format "auto" adapts per frame).
+        The wires, narrowest link to cheapest host: the DELTA-coded
+        segmented stream (db bits/event — sorted-key gaps per bank);
+        the bank-SEGMENTED bit-packed stream (kb bits/event — the bank
+        id never crosses the link); ONE uint32 word per event — bank id
+        folded into the key's spare high bits (4 bytes/event); the
         5-byte key+bank wire when key and bank bits don't fit one word.
 
         The pack itself runs in the native host runtime when available
@@ -406,9 +431,12 @@ class FusedPipeline:
             self._native_skip -= 1
             nat = None
         wire = self.config.wire_format
-        if wire == "seg" or (wire == "auto" and nat is not None):
-            valid, perm, banks = self._dispatch_seg(
-                cols, n, padded, nat, forced=wire == "seg")
+        if wire == "auto" and nat is not None:
+            wire = self._auto_wire()
+        if wire in ("seg", "delta"):
+            valid, perm, banks = self._dispatch_narrow(
+                cols, n, padded, nat, wire,
+                forced=self.config.wire_format != "auto")
             if valid is not None:
                 return valid, perm
             # Seg wire unavailable for this frame (native bypass armed,
@@ -485,12 +513,56 @@ class FusedPipeline:
         self.state, valid = self._step(self.state, jax.numpy.asarray(buf))
         return valid, None
 
-    def _dispatch_seg(self, cols: Dict[str, np.ndarray], n: int,
-                      padded: int, nat, forced: bool):
-        """Segmented-wire dispatch; returns (valid, perm, None) on
-        success, or (None, None, banks_or_None) when this frame should
-        fall back to the legacy wires (auto mode only: native bypass
-        armed by persistent out-of-LUT-window days, or a native
+    _WIRE_LADDER = ("word", "seg", "delta")
+
+    def _auto_wire(self) -> str:
+        """Per-frame wire choice for auto mode, from observed
+        backpressure.
+
+        The binding resource shifts with conditions outside our
+        control: when the host->device link is slow, fewer bits/event
+        wins (delta < seg < word on the wire); when the link is fast,
+        the heavier sort-based host packs of the narrow wires become
+        the bottleneck instead (word < seg < delta on the host; all
+        device steps are equal). Measured on the relay tunnel, the SAME
+        workload flips between word-wins (~1GB/s bursts) and
+        seg/delta-wins (~100MB/s sustained) across sessions — so auto
+        watches the in-flight deque: persistently full means the
+        device/link side is behind (narrow the wire, one ladder step),
+        persistently draining means the host is behind (widen).
+        Hysteresis keeps it from thrashing; a mid-stream switch is safe
+        because every frame is a self-contained dispatch.
+
+        Checkpointing holds frames until snapshot barriers, so depth
+        stops signalling backpressure — adaptation freezes at the
+        current level there.
+        """
+        if self.checkpointing:
+            return self._WIRE_LADDER[self._auto_level]
+        depth = len(self._inflight)
+        if depth >= _INFLIGHT_DEPTH - 1:
+            self._auto_pressure = min(self._auto_pressure + 1, 8)
+        elif depth <= 1:
+            self._auto_pressure = max(self._auto_pressure - 1, -8)
+        # Asymmetric hysteresis: a full deque means dispatches are
+        # cheap to divert into a narrower pack (climb after 2 signals),
+        # while descending costs re-paying link bytes — require
+        # sustained drain (6 signals) before widening.
+        if self._auto_pressure >= 2 and self._auto_level < 2:
+            self._auto_level += 1
+            self._auto_pressure = 0
+        elif self._auto_pressure <= -6 and self._auto_level > 0:
+            self._auto_level -= 1
+            self._auto_pressure = 0
+        return self._WIRE_LADDER[self._auto_level]
+
+    def _dispatch_narrow(self, cols: Dict[str, np.ndarray], n: int,
+                         padded: int, nat, mode: str, forced: bool):
+        """Sub-word-wire dispatch (``mode`` = "delta" or "seg" — one
+        LUT-miss/bypass protocol for both); returns (valid, perm, None)
+        on success, or (None, None, banks_or_None) when this frame
+        should fall back to the legacy wires (auto mode only: native
+        bypass armed by persistent out-of-LUT-window days, or a native
         scratch-allocation failure) — banks carries any day->bank
         resolution already done so the caller doesn't resolve twice."""
         sid, days = cols["student_id"], cols["lecture_day"]
@@ -499,17 +571,28 @@ class FusedPipeline:
         if nat is not None:
             if self._day_base is None:
                 self._rebuild_lut(int(days.min()))
-            frame_bits = nat.max_key(sid).bit_length()
+            frame_bits = (nat.max_key(sid).bit_length()
+                          if mode == "seg" else 0)
             for _attempt in (0, 1):
-                kb = min(max(frame_bits, 1, self._kw_hint), 32)
-                buf, perm, miss = nat.pack_seg(
-                    sid, days, self._day_lut, self._day_base, kb,
-                    padded, num_banks)
+                if mode == "seg":
+                    width = min(max(frame_bits, 1, self._kw_hint), 32)
+                    buf, perm, miss = nat.pack_seg(
+                        sid, days, self._day_lut, self._day_base,
+                        width, padded, num_banks)
+                else:
+                    buf, perm, width, miss = nat.pack_delta(
+                        sid, days, self._day_lut, self._day_base,
+                        self._db_hint, padded, num_banks)
                 if miss == -1:
-                    self._kw_hint = kb
-                    self.state, valid = self._seg_step(
-                        kb, padded, num_banks)(
-                            self.state, jax.numpy.asarray(buf))
+                    if mode == "seg":
+                        self._kw_hint = width
+                        step = self._seg_step(width, padded, num_banks)
+                    else:
+                        self._db_hint = width
+                        step = self._delta_step(width, padded,
+                                                num_banks)
+                    self.state, valid = step(self.state,
+                                             jax.numpy.asarray(buf))
                     return valid, perm, None
                 if miss == -2:  # scratch alloc failed / too many banks
                     if not forced:
@@ -531,18 +614,27 @@ class FusedPipeline:
                     if not forced:
                         return None, None, banks
                     break
-        # numpy packer: forced seg mode without (or past) the native
-        # runtime. argsort-based — correct for any day population, but
+        # numpy packer: forced mode without (or past) the native
+        # runtime. Sort-based — correct for any day population, but
         # slower than the fused native pass; auto mode prefers the
         # legacy wires in that situation.
         if banks is None:
             banks = self._banks_for(days)
             num_banks = self.state.hll_regs.shape[0]
-        kb = min(max(int(sid.max()).bit_length(), 1, self._kw_hint), 32)
-        self._kw_hint = kb
-        buf, perm = pack_seg(sid, banks, kb, padded, num_banks)
-        self.state, valid = self._seg_step(kb, padded, num_banks)(
-            self.state, jax.numpy.asarray(buf))
+        if mode == "seg":
+            kb = min(max(int(sid.max()).bit_length(), 1, self._kw_hint),
+                     32)
+            self._kw_hint = kb
+            buf, perm = pack_seg(sid, banks, kb, padded, num_banks)
+            step = self._seg_step(kb, padded, num_banks)
+        else:
+            scan = delta_scan(sid, banks, num_banks)
+            db = pick_delta_width(self._db_hint, scan[-1])
+            self._db_hint = db
+            buf, perm = pack_delta(sid, banks, db, padded, num_banks,
+                                   scan=scan)
+            step = self._delta_step(db, padded, num_banks)
+        self.state, valid = step(self.state, jax.numpy.asarray(buf))
         return valid, perm, None
 
     # -- checkpointing ------------------------------------------------------

@@ -24,8 +24,8 @@ import pytest
 from attendance_tpu.config import Config
 from attendance_tpu.models.bloom import bloom_add_packed
 from attendance_tpu.models.fused import (
-    fused_step, init_state, make_jitted_step_seg, pack_seg,
-    seg_buf_words)
+    delta_scan, fused_step, init_state, make_jitted_step_delta,
+    make_jitted_step_seg, pack_delta, pack_seg, seg_buf_words)
 from attendance_tpu.native import load as load_native
 from attendance_tpu.pipeline.fast_path import FusedPipeline
 from attendance_tpu.pipeline.loadgen import generate_frames
@@ -124,6 +124,77 @@ def test_seg_step_matches_fused_step(kb):
                                   np.asarray(vseg)[:n])
 
 
+def test_pack_delta_native_matches_numpy():
+    nat = load_native()
+    if nat is None:
+        pytest.skip("native host runtime unavailable")
+    rng = np.random.default_rng(9)
+    day_base = 20250100
+    for trial in range(20):
+        n = int(rng.integers(1, 3000))
+        padded = 1 << int(np.ceil(np.log2(max(n, 256))))
+        num_banks = int(rng.integers(1, 40))
+        kb = int(rng.integers(8, 33))
+        keys = rng.integers(0, 1 << kb, n,
+                            dtype=np.uint64).astype(np.uint32)
+        banks = rng.integers(0, num_banks, n).astype(np.int32)
+        days = (day_base + banks).astype(np.uint32)
+        lut = np.full(16384, -1, np.int32)
+        lut[:num_banks] = np.arange(num_banks)
+        buf_c, perm_c, db, miss = nat.pack_delta(
+            keys, days, lut, day_base, 1, padded, num_banks)
+        assert miss == -1
+        *_, needed = delta_scan(keys, banks, num_banks)
+        assert needed <= db <= 32
+        buf_np, perm_np = pack_delta(keys, banks, db, padded, num_banks)
+        np.testing.assert_array_equal(perm_c, perm_np)
+        np.testing.assert_array_equal(buf_c, buf_np)
+    # equal (bank, key) events keep append order (dedup tie contract)
+    keys = np.array([5, 5, 5, 9, 5], np.uint32)
+    days = np.full(5, day_base, np.uint32)
+    _, perm_c, _, miss = nat.pack_delta(keys, days, lut, day_base, 1,
+                                        256, 1)
+    assert miss == -1 and list(perm_c) == [0, 1, 2, 4, 3]
+
+
+@pytest.mark.parametrize("kb", [17, 22])
+def test_delta_step_matches_fused_step(kb):
+    rng = np.random.default_rng(100 + kb)
+    state, params = init_state(capacity=5000, num_banks=16)
+    roster = rng.choice(1 << min(kb, 17), 3000,
+                        replace=False).astype(np.uint32)
+    bits = bloom_add_packed(state.bloom_bits, jnp.asarray(roster), params)
+    state = state._replace(bloom_bits=bits)
+    state_d = state._replace(bloom_bits=jnp.array(np.asarray(bits)))
+
+    n, padded = 700, 1024
+    keys = np.where(rng.random(n) < 0.5, rng.choice(roster, n),
+                    rng.integers(0, 1 << kb, n,
+                                 dtype=np.uint64)).astype(np.uint32)
+    banks = rng.integers(0, 16, n).astype(np.int32)
+
+    mask = np.zeros(padded, bool)
+    mask[:n] = True
+    k_pad = np.zeros(padded, np.uint32)
+    k_pad[:n] = keys
+    b_pad = np.full(padded, -1, np.int32)
+    b_pad[:n] = banks
+    sref, vref = fused_step(state, jnp.asarray(k_pad),
+                            jnp.asarray(b_pad), jnp.asarray(mask), params)
+
+    *_, needed = delta_scan(keys, banks, 16)
+    buf, perm = pack_delta(keys, banks, needed, padded, 16)
+    step = make_jitted_step_delta(params, needed, padded, 16)
+    sdel, vdel = step(state_d, jnp.asarray(buf))
+
+    np.testing.assert_array_equal(np.asarray(sref.hll_regs),
+                                  np.asarray(sdel.hll_regs))
+    np.testing.assert_array_equal(np.asarray(sref.counts),
+                                  np.asarray(sdel.counts))
+    np.testing.assert_array_equal(np.asarray(vref)[:n][perm],
+                                  np.asarray(vdel)[:n])
+
+
 def _run_pipeline(wire_format: str, frames, roster, num_events: int):
     config = Config(bloom_filter_capacity=50_000,
                     transport_backend="memory", wire_format=wire_format)
@@ -148,16 +219,17 @@ def test_pipeline_equivalent_across_wires():
                                      invalid_fraction=0.2, seed=11)
     frames = list(frames)
     pipes = {w: _run_pipeline(w, frames, roster, num_events)
-             for w in ("word", "seg")}
+             for w in ("word", "seg", "delta")}
     dfs = {w: p.store.to_dataframe().sort_values(
         ["lecture_day", "micros", "student_id"]).reset_index(drop=True)
         for w, p in pipes.items()}
-    assert dfs["word"].equals(dfs["seg"])
-    assert (pipes["word"].validity_counts()
-            == pipes["seg"].validity_counts())
-    assert pipes["word"].lecture_days() == pipes["seg"].lecture_days()
-    for day in pipes["word"].lecture_days():
-        assert pipes["word"].count(day) == pipes["seg"].count(day)
+    for w in ("seg", "delta"):
+        assert dfs["word"].equals(dfs[w])
+        assert (pipes["word"].validity_counts()
+                == pipes[w].validity_counts())
+        assert pipes["word"].lecture_days() == pipes[w].lecture_days()
+        for day in pipes["word"].lecture_days():
+            assert pipes["word"].count(day) == pipes[w].count(day)
 
 
 def test_seg_wire_dedup_ties_keep_append_order():
@@ -175,13 +247,52 @@ def test_seg_wire_dedup_ties_keep_append_order():
     }
     frame = frame_from_columns(cols)
     roster = np.array([7, 8], np.uint32)
-    for wire in ("word", "seg"):
+    for wire in ("word", "seg", "delta"):
         pipe = _run_pipeline(wire, [frame], roster, 4)
         df = pipe.store.to_dataframe()  # deduped: 2 rows
         assert len(df) == 2
         # Last write wins: student 7's surviving row is the LAST
         # appended one (event_type exit).
         assert int(df[df.student_id == 7].event_type.item()) == 1
+
+
+def test_auto_wire_ladder_adapts_to_backpressure():
+    """The adaptive ladder must climb (narrower wire) under sustained
+    full-deque backpressure, descend under sustained drain, clamp at
+    both ends, and freeze while checkpointing."""
+    config = Config(transport_backend="memory", wire_format="auto")
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+
+    def drive(depth, frames):
+        pipe._inflight.clear()
+        pipe._inflight.extend([(None, None)] * depth)
+        return [pipe._auto_wire() for _ in range(frames)]
+
+    assert pipe._auto_level == 0
+    # Two full-deque signals climb one level; sustained pressure tops
+    # out at the ladder's end and stays clamped there.
+    seen = drive(8, 2)
+    assert pipe._auto_level == 1 and seen[-1] == "seg"
+    drive(8, 20)
+    assert pipe._auto_level == 2 and pipe._auto_wire() == "delta"
+    # Descent needs six drain signals per level, clamps at word.
+    pipe._inflight.clear()
+    seen = [pipe._auto_wire() for _ in range(5)]
+    assert pipe._auto_level == 2  # not yet
+    for _ in range(30):
+        pipe._auto_wire()
+    assert pipe._auto_level == 0 and pipe._auto_wire() == "word"
+    # Mid-depth frames are neutral: no drift in either direction.
+    pipe._auto_level, pipe._auto_pressure = 1, 0
+    drive(4, 50)
+    assert pipe._auto_level == 1
+    # Checkpointing freezes adaptation at the current level.
+    pipe._snap_dir = object()
+    pipe._inflight.clear()
+    pipe._inflight.extend([(None, None)] * 8)
+    assert [pipe._auto_wire() for _ in range(10)] == ["seg"] * 10
+    assert pipe._auto_level == 1 and pipe._auto_pressure == 0
 
 
 def test_seg_wire_out_of_window_days_fall_back():
@@ -204,7 +315,7 @@ def test_seg_wire_out_of_window_days_fall_back():
         "event_type": np.zeros(n, np.int8),
     }
     frame = frame_from_columns(cols)
-    for wire in ("auto", "seg"):
+    for wire in ("auto", "seg", "delta"):
         pipe = _run_pipeline(wire, [frame], roster, n)
         assert pipe.metrics.events == n
         df = pipe.store.to_dataframe(deduplicate=False)
