@@ -111,6 +111,7 @@ class ShardedSketchEngine:
         if self.m_regs % self.sp:
             raise ValueError(f"sp={self.sp} must divide {self.m_regs}")
         self.num_banks = num_banks
+        self._word_step_cache = {}
 
         bits_sharding = NamedSharding(mesh, P("sp"))
         # HLL registers carry a leading replica axis: regs[r] is replica
@@ -199,6 +200,36 @@ class ShardedSketchEngine:
                 regs_loc, jnp.where(valid, bank_idx, -1), keys, mask)
             return valid, new_regs
 
+        def make_step_words(kw: int):
+            """step_kernel over the packed word wire (see
+            models.fused.fused_step_words): ONE uint32 per event — low
+            kw bits the key, high bits the bank id, all-ones bank field
+            marking padded lanes. Per-chip ingest drops from 9 B/event
+            (keys + bank ids + mask) to 4, the same host-link economy
+            the single-chip pipeline gets from its wire ladder."""
+            key_mask = jnp.uint32((1 << kw) - 1)
+            sentinel = jnp.uint32((1 << (32 - kw)) - 1)
+
+            def step_words_kernel(bits_loc, regs_loc, words):
+                keys = words & key_mask
+                banks_u = words >> kw
+                bank_idx = jnp.where(banks_u == sentinel, jnp.int32(-1),
+                                     banks_u.astype(jnp.int32))
+                mask = bank_idx >= 0
+                partial = local_contains(bits_loc, keys)
+                valid = jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
+                new_regs = hll_add_local(
+                    regs_loc, jnp.where(valid, bank_idx, -1), keys, mask)
+                return valid, new_regs
+
+            return jax.jit(jax.shard_map(
+                step_words_kernel, mesh=mesh,
+                in_specs=(P("sp"), P("dp", None, "sp"), P("dp")),
+                out_specs=(P("dp"), P("dp", None, "sp"))),
+                donate_argnums=(1,))
+
+        self._make_step_words = make_step_words
+
         def query_kernel(bits_loc, keys):
             partial = local_contains(bits_loc, keys)
             return jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
@@ -241,17 +272,21 @@ class ShardedSketchEngine:
             hist_kernel, in_specs=(regs_spec,), out_specs=P(None)))
 
     # -- padded batch helpers ------------------------------------------------
-    def _pad(self, arr: np.ndarray, fill, dtype) -> Tuple[np.ndarray, int]:
-        # Pad to the next power of two (min 256), then up to a multiple of
-        # dp so the batch axis splits evenly across replicas even when dp
-        # is not a power of two (e.g. a 6-device dp=3 x sp=2 mesh). The
-        # set of compiled shapes stays bounded: one per power of two.
-        n = len(arr)
+    def padded_size(self, n: int) -> int:
+        """Batch-axis size policy: next power of two (min 256), rounded
+        up to a dp multiple so the axis splits evenly across replicas
+        even when dp is not a power of two (e.g. a 6-device dp=3 x
+        sp=2 mesh). The set of compiled shapes stays bounded: one per
+        power of two. The single definition for step, step_words
+        callers, and preload chunking."""
         padded = 256
         while padded < n:
             padded *= 2
-        padded = ((padded + self.dp - 1) // self.dp) * self.dp
-        buf = np.full(padded, fill, dtype=dtype)
+        return ((padded + self.dp - 1) // self.dp) * self.dp
+
+    def _pad(self, arr: np.ndarray, fill, dtype) -> Tuple[np.ndarray, int]:
+        n = len(arr)
+        buf = np.full(self.padded_size(n), fill, dtype=dtype)
         buf[:n] = arr
         return buf, n
 
@@ -271,6 +306,17 @@ class ShardedSketchEngine:
         self.bits = chunked_preload(
             lambda bits, c: self._preload(bits, c, mask),
             self.bits, keys, chunk=chunk)
+
+    def step_words(self, words, n: int, kw: int) -> jax.Array:
+        """Fused validate+count over the packed word wire; ``words`` is
+        already padded (pad lanes = 0xFFFFFFFF) to a dp multiple.
+        Returns validity[:n] (async device array, like :meth:`step`).
+        One compiled program per key width, cached."""
+        step = self._word_step_cache.get(kw)
+        if step is None:
+            step = self._word_step_cache[kw] = self._make_step_words(kw)
+        valid, self.regs = step(self.bits, self.regs, jnp.asarray(words))
+        return valid[:n]
 
     def step(self, keys, bank_idx) -> jax.Array:
         """Fused validate+count for one micro-batch; returns validity[B].

@@ -145,6 +145,9 @@ class FusedPipeline:
                 layout="blocked",
                 replica_sync=self.config.replica_sync)
             self.params = self.engine.params
+            # Monotonic key-width hint for the mesh word wire (same
+            # compile-churn bound as the single-chip _pick_kw path).
+            self._kw_hint = 1
         else:
             self.engine = None
             self.state, self.params = init_state(
@@ -330,9 +333,20 @@ class FusedPipeline:
         if n == 0:
             return None
         if self.sharded:
+            sid = cols["student_id"]
             banks = self._banks_for(cols["lecture_day"])
+            num_banks = self.engine.num_banks
+            kw = self._pick_kw(int(sid.max()).bit_length(), num_banks)
             with maybe_annotate(self._profiling, "sharded_fused_step"):
-                valid_n = self.engine.step(cols["student_id"], banks)
+                if kw + num_banks.bit_length() <= 32:
+                    # Packed word wire onto the mesh: 4 B/event per
+                    # chip instead of the 9 of keys + bank ids + mask.
+                    self._kw_hint = kw
+                    words = pack_words(sid, banks, kw,
+                                       self.engine.padded_size(n))
+                    valid_n = self.engine.step_words(words, n, kw)
+                else:
+                    valid_n = self.engine.step(sid, banks)
             stored = valid_n
         else:
             padded = 256

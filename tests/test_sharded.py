@@ -63,6 +63,36 @@ def test_sharded_matches_single_device(dp, sp):
         assert ref.count(b) == eng.count(b)
 
 
+@pytest.mark.parametrize("dp,sp", [(2, 4)])
+def test_step_words_matches_step(dp, sp):
+    """The packed word wire onto the mesh must be observationally
+    identical to the (keys, banks, mask) wire: same validity, same
+    register state, including padded lanes."""
+    from attendance_tpu.models.fused import pack_words
+
+    ref = engine(dp, sp)
+    eng = engine(dp, sp)
+    roster = np.arange(10_000, 14_000, dtype=np.uint32)
+    ref.preload(roster)
+    eng.preload(roster)
+
+    rng = np.random.default_rng(7)
+    n = 3_000  # pads to 4096
+    keys = rng.choice(
+        np.concatenate([roster, np.arange(1 << 20, (1 << 20) + 4_000,
+                                          dtype=np.uint32)]),
+        size=n).astype(np.uint32)
+    banks = rng.integers(0, 8, size=n).astype(np.int32)
+    v_ref = np.asarray(ref.step(keys, banks))
+    kw = int(keys.max()).bit_length()
+    padded = ((4096 + dp - 1) // dp) * dp
+    words = pack_words(keys, banks, kw, padded)
+    v_eng = np.asarray(eng.step_words(words, n, kw))
+    np.testing.assert_array_equal(v_ref, v_eng)
+    for b in range(8):
+        assert ref.count(b) == eng.count(b)
+
+
 def test_dp_replicas_converge_to_union_state():
     """After a step, every replica holds the OR/max-merged state: keys
     processed by replica 0 must be countable when queried via any replica
