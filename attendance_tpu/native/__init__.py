@@ -145,6 +145,8 @@ class HostPipe:
             _ptr(out, _u32p))
         if rc == 0:
             return out, -1
+        if rc < 0:  # a key overflowed kw bits: retry with a wider width
+            return None, -3
         return None, int(rc - 1)
 
     def pack_seg(self, keys: np.ndarray, days: np.ndarray,
@@ -167,6 +169,8 @@ class HostPipe:
             num_banks, _ptr(buf, _u32p), len(buf), _ptr(perm, _u32p))
         if rc == 0:
             return buf, perm[:len(keys)], -1
+        if rc == -2:  # a key overflowed kb bits: retry with wider width
+            return None, None, -3
         if rc < 0:
             return None, None, -2
         return None, None, int(rc - 1)

@@ -80,24 +80,33 @@ uint32_t atp_max_key(const uint8_t *keys, size_t n, size_t stride) {
  * out[i] = lut[day[i] - day_base] << kw | key[i]   for i < n
  * out[i] = 0xFFFFFFFF (padding sentinel)           for n <= i < padded
  *
- * Returns 0 on success, or 1 + the index of the first event whose day
+ * Returns 0 on success, 1 + the index of the first event whose day
  * fell outside the LUT window or had no registered bank (lut value
- * < 0).  On miss the caller registers the missing day(s) in Python and
- * calls again — out[] contents before the miss index are valid but the
- * call must be retried in full. */
+ * < 0), or -2 when some key did not fit kw bits.  The overflow check
+ * rides the pack itself (one OR per event), so callers can try their
+ * monotonic width hint straight away and skip the separate max-key
+ * scan on every steady-state frame — the widen-and-retry only runs
+ * when the population actually grows.  On a miss the caller registers
+ * the missing day(s) in Python and calls again — out[] contents
+ * before the miss index are valid but the call must be retried in
+ * full. */
 int64_t atp_pack_words(const uint8_t *keys, size_t key_stride,
                        const uint8_t *days, size_t day_stride,
                        size_t n, size_t padded,
                        const int32_t *lut, uint32_t day_base,
                        uint32_t lut_size, uint32_t kw,
                        uint32_t *out) {
+    uint32_t overflow = 0;
     for (size_t i = 0; i < n; ++i) {
         uint32_t off = ld_u32(days, i, day_stride) - day_base;
         if (off >= lut_size) return 1 + (int64_t)i;
         int32_t bank = lut[off];
         if (bank < 0) return 1 + (int64_t)i;
-        out[i] = ((uint32_t)bank << kw) | ld_u32(keys, i, key_stride);
+        uint32_t k = ld_u32(keys, i, key_stride);
+        overflow |= kw < 32 ? (k >> kw) : 0;
+        out[i] = ((uint32_t)bank << kw) | k;
     }
+    if (overflow) return -2;
     for (size_t i = n; i < padded; ++i)
         out[i] = 0xFFFFFFFFu;
     return 0;
@@ -195,11 +204,17 @@ int64_t atp_pack_seg(const uint8_t *keys, size_t key_stride,
         pos += counts[b];
     }
     uint8_t *stream = (uint8_t *)(out_buf + num_banks);
+    uint32_t overflow = 0;
     for (size_t i = 0; i < n; ++i) {
         uint32_t dst = offsets[bank_tmp[i]]++;
         out_perm[dst] = (uint32_t)i;
         uint64_t bit = (uint64_t)dst * kb;
-        uint64_t v = (uint64_t)ld_u32(keys, i, key_stride) << (bit & 7);
+        uint32_t k = ld_u32(keys, i, key_stride);
+        /* Overflow detection rides the pack (see atp_pack_words): a
+         * key wider than kb bits would corrupt neighbouring lanes in
+         * the bitstream, so the caller retries with a wider kb. */
+        overflow |= kb < 32 ? (k >> kb) : 0;
+        uint64_t v = (uint64_t)k << (bit & 7);
         uint8_t *p = stream + (bit >> 3);
         /* kb + 7 <= 39 bits: one unaligned u64 read-modify-write
          * covers any span (memcpy compiles to plain movs); the guard
@@ -210,7 +225,7 @@ int64_t atp_pack_seg(const uint8_t *keys, size_t key_stride,
         cur |= v;
         memcpy(p, &cur, 8);
     }
-    return 0;
+    return overflow ? -2 : 0;
 }
 
 /* Delta wire scan: sort by (bank, key) and emit the per-event deltas.

@@ -396,6 +396,19 @@ class FusedPipeline:
                 self.config.hll_precision)
         return step
 
+    def _rescan_width(self, nat, sid, num_banks: int):
+        """Real frame key width via the native max-key scan, plus the
+        word-wire verdict for it. When the frame outgrows the word
+        budget, the width is folded into the hint so subsequent frames
+        take the cheap top-of-loop check straight to the bytes wire
+        instead of re-paying a doomed hinted pack every frame."""
+        frame_bits = nat.max_key(sid).bit_length()
+        kw = self._pick_kw(frame_bits, num_banks)
+        use_words = kw + num_banks.bit_length() <= 32
+        if not use_words:
+            self._kw_hint = max(self._kw_hint, frame_bits)
+        return frame_bits, kw, use_words
+
     def _pick_kw(self, frame_bits: int, num_banks: int) -> int:
         """Key width for the word wire: the frame's own max-key bits,
         widened to the monotonic hint (fewer distinct compiled widths) —
@@ -463,20 +476,39 @@ class FusedPipeline:
         if nat is not None:
             if self._day_base is None:
                 self._rebuild_lut(int(days.min()))
-            frame_bits = nat.max_key(sid).bit_length()
+            # Key width is the monotonic hint, trusted without a
+            # per-frame max-key scan: the native pack detects overflow
+            # itself (miss == -3), and only then is the real width
+            # scanned and the pack retried — on this single-core host
+            # every avoided pass over the frame is throughput.
+            frame_bits = None
             for _attempt in (0, 1):
-                kw = self._pick_kw(frame_bits, num_banks)
+                kw = (max(self._kw_hint, 1) if frame_bits is None
+                      else self._pick_kw(frame_bits, num_banks))
                 use_words = (kw + num_banks.bit_length() <= 32
                              and wire != "bytes")
+                if not use_words and frame_bits is None \
+                        and wire != "bytes":
+                    # The hint outgrew the word budget; the frame's own
+                    # width may still fit (_pick_kw drops the hint).
+                    frame_bits, kw, use_words = self._rescan_width(
+                        nat, sid, num_banks)
                 if use_words:
                     words, miss = nat.pack_words(
                         sid, days, self._day_lut, self._day_base, kw,
                         padded)
-                else:
+                    if miss == -3:  # hinted width overflowed: rescan
+                        frame_bits, kw, use_words = self._rescan_width(
+                            nat, sid, num_banks)
+                        if use_words:
+                            words, miss = nat.pack_words(
+                                sid, days, self._day_lut,
+                                self._day_base, kw, padded)
+                if not use_words:
                     words, miss = nat.pack_bytes(
                         sid, days, self._day_lut, self._day_base,
                         np.dtype(self._bank_dtype).itemsize, padded)
-                if miss < 0:
+                if miss == -1:
                     if use_words:
                         self._kw_hint = kw
                         self.state, valid = self._word_step(kw)(
@@ -585,14 +617,21 @@ class FusedPipeline:
         if nat is not None:
             if self._day_base is None:
                 self._rebuild_lut(int(days.min()))
-            frame_bits = (nat.max_key(sid).bit_length()
-                          if mode == "seg" else 0)
             for _attempt in (0, 1):
                 if mode == "seg":
-                    width = min(max(frame_bits, 1, self._kw_hint), 32)
+                    # Trust the monotonic width hint; the pack detects
+                    # overflow itself (miss == -3) and we rescan only
+                    # then — same economy as the word path.
+                    width = min(max(1, self._kw_hint), 32)
                     buf, perm, miss = nat.pack_seg(
                         sid, days, self._day_lut, self._day_base,
                         width, padded, num_banks)
+                    if miss == -3:
+                        width = min(max(nat.max_key(sid).bit_length(),
+                                        1, self._kw_hint), 32)
+                        buf, perm, miss = nat.pack_seg(
+                            sid, days, self._day_lut, self._day_base,
+                            width, padded, num_banks)
                 else:
                     buf, perm, width, miss = nat.pack_delta(
                         sid, days, self._day_lut, self._day_base,

@@ -87,6 +87,33 @@ def test_pack_seg_native_strided_and_miss():
     assert miss == 37
 
 
+def test_native_pack_overflow_signalling():
+    """A key wider than the requested width must be reported (miss ==
+    -3), never silently packed corrupt — the dispatchers trust the
+    monotonic width hint and rely on this signal to rescan."""
+    nat = load_native()
+    if nat is None:
+        pytest.skip("native host runtime unavailable")
+    day_base = 20250100
+    lut = np.full(256, -1, np.int32)
+    lut[:8] = np.arange(8)
+    keys = np.array([100, 5000, 70000], np.uint32)  # 70000: 17 bits
+    days = np.full(3, day_base, np.uint32)
+    words, miss = nat.pack_words(keys, days, lut, day_base, 10, 256)
+    assert words is None and miss == -3
+    words, miss = nat.pack_words(keys, days, lut, day_base, 17, 256)
+    assert miss == -1 and (words[:3] == keys).all()
+    buf, perm, miss = nat.pack_seg(keys, days, lut, day_base, 10, 256, 8)
+    assert buf is None and miss == -3
+    _, _, miss = nat.pack_seg(keys, days, lut, day_base, 17, 256, 8)
+    assert miss == -1
+    # A LUT miss aborts at its index before the overflow verdict.
+    days_bad = days.copy()
+    days_bad[1] = day_base + 99
+    _, miss = nat.pack_words(keys, days_bad, lut, day_base, 10, 256)
+    assert miss == 1
+
+
 @pytest.mark.parametrize("kb", [17, 22, 32])
 def test_seg_step_matches_fused_step(kb):
     rng = np.random.default_rng(kb)
