@@ -138,6 +138,21 @@ def hll_histogram(regs: jax.Array, precision: int = 14) -> jax.Array:
     )(regs)
 
 
+def hll_histogram_compare(regs: jax.Array,
+                          precision: int = 14) -> jax.Array:
+    """Histogram by compare-and-reduce: one vmapped equality+sum per
+    register value (52 masked sums). No scatter, no bincount — the
+    per-bank scatter-add formulations (vmapped bincount, and the Pallas
+    compare kernel's Mosaic lowering) both blow up XLA compile time
+    past a few hundred banks on the TPU backend (measured: 1024 banks
+    never finishes), while this shape compiles in seconds at any bank
+    count and runs bandwidth-bound."""
+    q = 64 - precision
+    vals = jnp.arange(q + 2, dtype=regs.dtype)
+    return jax.vmap(
+        lambda v: jnp.sum(regs == v, axis=1, dtype=jnp.int32))(vals).T
+
+
 def _sigma(x: float) -> float:
     """Ertl's sigma: sum used by the linear-counting-range correction."""
     if x == 1.0:
@@ -199,9 +214,15 @@ def best_histogram(regs: jax.Array, precision: int = 14) -> jax.Array:
 
     On TPU the Pallas compare-reduce kernel (ops.pallas_kernels) beats
     XLA's one-hot scatter-add bincount; on CPU the interpreter overhead
-    inverts that, so the XLA path stays default there.
+    inverts that, so the XLA path stays default there. Past a few
+    hundred banks both TPU formulations hit pathological XLA/Mosaic
+    compile times (the CPU backend compiles the bincount fine), so wide
+    register arrays on device backends take the vectorized
+    compare-reduce (:func:`hll_histogram_compare`) instead.
     """
     if jax.default_backend() != "cpu":
+        if regs.shape[0] > 128:
+            return hll_histogram_compare(regs, precision)
         try:
             from attendance_tpu.ops.pallas_kernels import (
                 hll_histogram_pallas)

@@ -48,6 +48,20 @@ def _enable_compilation_cache() -> None:
     enable_compilation_cache(str(Path(__file__).resolve().parent))
 
 
+def _make_roster(rng, capacity: int) -> np.ndarray:
+    return rng.choice(1 << 31, size=capacity, replace=False
+                      ).astype(np.uint32)
+
+
+def _query_mix_bufs(rng, roster: np.ndarray, batch_size: int, n_bufs=8):
+    """Device-resident key batches, 50% roster members / 50% keys from
+    a disjoint range (the intended negative population)."""
+    return [jax.device_put(np.where(
+        rng.random(batch_size) < 0.5, rng.choice(roster, batch_size),
+        rng.integers(1 << 31, 1 << 32, size=batch_size, dtype=np.uint32)
+    ).astype(np.uint32)) for _ in range(n_bufs)]
+
+
 def bench_fused_step(batch_size: int, seconds: float, capacity: int,
                      num_banks: int, layout: str) -> dict:
     from attendance_tpu.models.bloom import bloom_add_packed
@@ -59,8 +73,7 @@ def bench_fused_step(batch_size: int, seconds: float, capacity: int,
     step = make_jitted_step(params)
 
     rng = np.random.default_rng(0)
-    roster = rng.choice(1 << 31, size=capacity, replace=False
-                        ).astype(np.uint32)
+    roster = _make_roster(rng, capacity)
     # Preload the roster so ~half the stream validates true.
     preload = jax.jit(lambda b, k: bloom_add_packed(b, k, params),
                       donate_argnums=(0,))
@@ -68,15 +81,10 @@ def bench_fused_step(batch_size: int, seconds: float, capacity: int,
         bloom_bits=chunked_preload(preload, state.bloom_bits, roster))
 
     n_bufs = 8  # rotate pre-staged device-resident input batches
-    keys_bufs, bank_bufs = [], []
-    for _ in range(n_bufs):
-        mix = np.where(rng.random(batch_size) < 0.5,
-                       rng.choice(roster, size=batch_size),
-                       rng.integers(1 << 31, 1 << 32, size=batch_size,
-                                    dtype=np.uint32)).astype(np.uint32)
-        keys_bufs.append(jax.device_put(mix))
-        bank_bufs.append(jax.device_put(
-            rng.integers(0, num_banks, size=batch_size, dtype=np.int32)))
+    keys_bufs = _query_mix_bufs(rng, roster, batch_size, n_bufs)
+    bank_bufs = [jax.device_put(
+        rng.integers(0, num_banks, size=batch_size, dtype=np.int32))
+        for _ in range(n_bufs)]
     mask = jax.device_put(np.ones(batch_size, dtype=bool))
 
     # warmup / compile
@@ -103,6 +111,116 @@ def bench_fused_step(batch_size: int, seconds: float, capacity: int,
         "elapsed_s": elapsed,
         "device": str(jax.devices()[0]),
     }
+
+
+def bench_bloom(batch_size: int, seconds: float, capacity: int,
+                layout: str) -> dict:
+    """BASELINE.md bench config #2: the Bloom kernels alone — the
+    murmur3-lane + scatter-OR INSERT (the kernel config #2 names) and
+    the packed-word gather/AND membership query, each timed over
+    device-resident batches on one core."""
+    from attendance_tpu.models.bloom import (
+        bloom_add_packed, bloom_contains_words, bloom_packed_init,
+        derive_bloom_params)
+    from attendance_tpu.pipeline.fast_path import chunked_preload
+
+    params = derive_bloom_params(capacity, 0.01, layout)
+    rng = np.random.default_rng(0)
+    roster = _make_roster(rng, capacity)
+    add = jax.jit(lambda b, k: bloom_add_packed(b, k, params),
+                  donate_argnums=(0,))
+    bits = chunked_preload(add, bloom_packed_init(params), roster)
+    query = jax.jit(lambda b, k: bloom_contains_words(b, k, params))
+    bufs = _query_mix_bufs(rng, roster, batch_size)
+
+    # Membership query rate FIRST, against the filter at its configured
+    # occupancy — timing it after the insert chain would query a
+    # saturated filter and make the 50/50 positive/negative mix above
+    # meaningless.
+    out = query(bits, bufs[0])
+    out.block_until_ready()
+    steps, t0 = 0, time.perf_counter()
+    while True:
+        out = query(bits, bufs[steps % 8])
+        steps += 1
+        if steps % 50 == 0:
+            out.block_until_ready()
+            if time.perf_counter() - t0 >= seconds / 2:
+                break
+    out.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    query_rate = steps * batch_size / elapsed
+
+    # Insert (scatter-OR) rate: donated chain, half the window. Reuses
+    # the preload program's chunk shape — the 2^20-key scatter variant
+    # hits a pathological XLA compile on this backend, and one compiled
+    # scatter shape is the library's own chunked-preload policy anyway.
+    from attendance_tpu.models.bloom import PRELOAD_CHUNK
+
+    ibufs = [jax.device_put(
+        rng.integers(0, 1 << 31, size=PRELOAD_CHUNK, dtype=np.uint32))
+        for _ in range(8)]
+    bits = add(bits, ibufs[0])
+    bits.block_until_ready()
+    isteps, t0 = 0, time.perf_counter()
+    while True:
+        bits = add(bits, ibufs[isteps % 8])
+        isteps += 1
+        if isteps % 50 == 0:
+            bits.block_until_ready()
+            if time.perf_counter() - t0 >= seconds / 2:
+                break
+    bits.block_until_ready()
+    insert_rate = isteps * PRELOAD_CHUNK / (time.perf_counter() - t0)
+
+    return {"events_per_sec": query_rate,
+            "insert_keys_per_sec": insert_rate,
+            "steps": steps, "batch_size": batch_size}
+
+
+def bench_hll(batch_size: int, seconds: float, num_banks: int) -> dict:
+    """BASELINE.md bench config #3: batched PFADD into
+    [num_banks, 2^14] register banks, with a device-resident PFCOUNT
+    register-histogram pass folded into the timed window every 256
+    batches. The Ertl estimator's final ~50 host flops per bank (and
+    any readback) are excluded — see the no-D2H note below."""
+    from attendance_tpu.models.hll import best_histogram, hll_add, hll_init
+
+    rng = np.random.default_rng(0)
+    regs = hll_init(num_banks, 14)
+    add = jax.jit(lambda r, b, k: hll_add(r, b, k, precision=14),
+                  donate_argnums=(0,))
+    key_bufs = [jax.device_put(
+        rng.integers(0, 1 << 32, size=batch_size, dtype=np.uint32))
+        for _ in range(8)]
+    bank_bufs = [jax.device_put(
+        rng.integers(0, num_banks, size=batch_size, dtype=np.int32))
+        for _ in range(8)]
+    hist = jax.jit(lambda r: best_histogram(r, 14))
+    regs = add(regs, bank_bufs[0], key_bufs[0])
+    h = hist(regs)
+    jax.block_until_ready((regs, h))
+    # NO device->host read anywhere in this process: on this platform
+    # even one D2H collapses async dispatch for the rest of the process
+    # (~800x here, measured — the same pathology pipeline.fast_path.run
+    # documents), which would bench the wreckage instead of the kernel.
+    # The PFCOUNT histograms therefore stay device-resident; accuracy
+    # is pinned by tests/test_hll.py and the redis parity harness.
+    steps, t0 = 0, time.perf_counter()
+    while True:
+        regs = add(regs, bank_bufs[steps % 8], key_bufs[steps % 8])
+        steps += 1
+        if steps % 256 == 0:
+            h = hist(regs)
+        if steps % 50 == 0:
+            regs.block_until_ready()
+            if time.perf_counter() - t0 >= seconds:
+                break
+    jax.block_until_ready((regs, h))
+    elapsed = time.perf_counter() - t0
+    return {"events_per_sec": steps * batch_size / elapsed,
+            "steps": steps, "batch_size": batch_size,
+            "num_banks": num_banks}
 
 
 def bench_e2e(batch_size: int, seconds: float, capacity: int,
@@ -189,7 +307,10 @@ def _vs_baseline(events_per_sec: float) -> float:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="both",
-                    choices=["both", "kernel", "e2e"])
+                    choices=["both", "kernel", "e2e", "bloom", "hll"],
+                    help="both/kernel/e2e are the headline benches; "
+                    "bloom and hll time the standalone sketch kernels "
+                    "(BASELINE.md configs #2 and #3)")
     ap.add_argument("--batch-size", type=int, default=1 << 20,
                     help="kernel-mode device batch size")
     ap.add_argument("--e2e-batch-size", type=int, default=None,
@@ -197,7 +318,9 @@ def main() -> None:
                     "defaults to 2^19, or to --batch-size in e2e mode")
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--capacity", type=int, default=1_000_000)
-    ap.add_argument("--num-banks", type=int, default=64)
+    ap.add_argument("--num-banks", type=int, default=None,
+                    help="HLL banks (default: 64; 1024 in --mode=hll, "
+                    "matching BASELINE.md config #3)")
     ap.add_argument("--layout", default="blocked",
                     choices=["blocked", "flat"])
     ap.add_argument("--profile-dir", default="",
@@ -209,6 +332,8 @@ def main() -> None:
     if args.e2e_batch_size is None:
         args.e2e_batch_size = (args.batch_size if args.mode == "e2e"
                                else 1 << 19)
+    if args.num_banks is None:
+        args.num_banks = 1024 if args.mode == "hll" else 64
     _enable_compilation_cache()
     from attendance_tpu.utils.profiling import maybe_trace
 
@@ -221,6 +346,25 @@ def main() -> None:
                 "value": round(r["events_per_sec"], 1),
                 "unit": "events/sec",
                 "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+            }
+        elif args.mode == "bloom":
+            r = bench_bloom(args.batch_size, args.seconds, args.capacity,
+                            args.layout)
+            line = {
+                "metric": "bloom_membership_throughput",
+                "value": round(r["events_per_sec"], 1),
+                "unit": "keys/sec",
+                "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+                "insert_keys_per_sec": round(r["insert_keys_per_sec"], 1),
+            }
+        elif args.mode == "hll":
+            r = bench_hll(args.batch_size, args.seconds, args.num_banks)
+            line = {
+                "metric": "hll_pfadd_throughput",
+                "value": round(r["events_per_sec"], 1),
+                "unit": "events/sec",
+                "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+                "num_banks": r["num_banks"],
             }
         elif args.mode == "e2e":
             r = bench_e2e(args.e2e_batch_size, args.seconds, args.capacity,

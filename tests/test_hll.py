@@ -74,3 +74,25 @@ def test_merge_equals_union():
 def test_empty_bank_estimates_zero():
     hist = np.asarray(hll_histogram(hll_init(1)))[0]
     assert estimate_from_histogram(hist) == 0.0
+
+
+def test_histogram_compare_matches_bincount():
+    """The compare-reduce histogram (the wide-bank path best_histogram
+    takes past 128 banks, where the per-bank formulations hit
+    pathological compile times) must agree exactly with the vmapped
+    bincount on populated registers."""
+    from attendance_tpu.models.hll import (
+        best_histogram, hll_histogram_compare)
+
+    rng = np.random.default_rng(3)
+    regs = hll_add(
+        hll_init(6),
+        np.asarray(rng.integers(0, 6, 50_000), np.int32),
+        np.asarray(rng.integers(0, 1 << 32, 50_000, dtype=np.uint64
+                                ).astype(np.uint32)))
+    np.testing.assert_array_equal(np.asarray(hll_histogram(regs)),
+                                  np.asarray(hll_histogram_compare(regs)))
+    # Wide bank counts route through the compare path and keep shape.
+    wide = np.asarray(best_histogram(hll_init(256)))
+    assert wide.shape == (256, 52)
+    assert (wide[:, 0] == 1 << 14).all()
