@@ -209,20 +209,32 @@ def hll_merge(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.maximum(a, b)
 
 
+def _histogram_route(num_banks: int, backend: str) -> str:
+    """Implementation choice for best_histogram, factored out so the
+    routing (which only matters on device backends the hermetic CPU
+    suite cannot execute) is itself testable: "pallas" and "bincount"
+    hit pathological XLA/Mosaic compile times past a few hundred banks
+    on the TPU backend (measured: 1024 banks never finishes), while
+    the CPU backend compiles the bincount fine at any width and runs
+    it faster than 52 compare passes."""
+    if backend != "cpu":
+        return "compare" if num_banks > 128 else "pallas"
+    return "bincount"
+
+
 def best_histogram(regs: jax.Array, precision: int = 14) -> jax.Array:
     """Histogram via the fastest available path for the current backend.
 
     On TPU the Pallas compare-reduce kernel (ops.pallas_kernels) beats
-    XLA's one-hot scatter-add bincount; on CPU the interpreter overhead
-    inverts that, so the XLA path stays default there. Past a few
-    hundred banks both TPU formulations hit pathological XLA/Mosaic
-    compile times (the CPU backend compiles the bincount fine), so wide
-    register arrays on device backends take the vectorized
-    compare-reduce (:func:`hll_histogram_compare`) instead.
+    XLA's one-hot scatter-add bincount for narrow bank counts and the
+    vectorized compare-reduce takes over for wide ones; on CPU the
+    interpreter overhead inverts both, so the XLA bincount stays
+    default there (see :func:`_histogram_route`).
     """
-    if jax.default_backend() != "cpu":
-        if regs.shape[0] > 128:
-            return hll_histogram_compare(regs, precision)
+    route = _histogram_route(regs.shape[0], jax.default_backend())
+    if route == "compare":
+        return hll_histogram_compare(regs, precision)
+    if route == "pallas":
         try:
             from attendance_tpu.ops.pallas_kernels import (
                 hll_histogram_pallas)

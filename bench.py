@@ -267,12 +267,13 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
     producer.send(frames[0])
     pipe.run(max_events=batch_size, idle_timeout_s=0.2)
 
-    # Three measured passes over the same backlog (frame bytes are
+    # Five measured passes over the same backlog (frame bytes are
     # re-sent by reference — no regeneration); the MEDIAN rate is
     # reported. A single drain-bound pass on a shared host/tunnel sees
-    # multi-x run-to-run jitter; the median is stable.
+    # multi-x run-to-run jitter; the median across five is the
+    # stablest artifact the per-round recording gets.
     rates = []
-    for _ in range(3):
+    for _ in range(5):
         for frame in frames:
             producer.send(frame)
         pipe.metrics.events = 0

@@ -92,7 +92,15 @@ def test_histogram_compare_matches_bincount():
                                 ).astype(np.uint32)))
     np.testing.assert_array_equal(np.asarray(hll_histogram(regs)),
                                   np.asarray(hll_histogram_compare(regs)))
-    # Wide bank counts route through the compare path and keep shape.
     wide = np.asarray(best_histogram(hll_init(256)))
     assert wide.shape == (256, 52)
     assert (wide[:, 0] == 1 << 14).all()
+    # Routing (device backends are outside the hermetic CPU suite, so
+    # the decision function is pinned directly): wide register arrays
+    # must avoid the formulations whose device compile never finishes.
+    from attendance_tpu.models.hll import _histogram_route
+
+    assert _histogram_route(1024, "tpu") == "compare"
+    assert _histogram_route(64, "tpu") == "pallas"
+    assert _histogram_route(1024, "cpu") == "bincount"
+    assert _histogram_route(64, "cpu") == "bincount"

@@ -2,7 +2,8 @@
 
 Repeatedly crashes a checkpointed pipeline at random progress points —
 random batch sizes, mesh shapes (single-chip and sharded), capacities,
-and snapshot cadences — and asserts the final store + PFCOUNTs always
+wire formats, and snapshot cadences — and asserts the final store +
+PFCOUNTs always
 equal an uninterrupted reference run. Exercises the full
 at-least-once / idempotent-replay / snapshot-barrier story end to end
 (SURVEY.md §5); kept out of the default suite for runtime (~1 min).
@@ -41,10 +42,13 @@ def test_randomized_crash_restart_soak():
             seed=int(rng.integers(1e6)))
         frames = list(frames)
 
+        wire = str(rng.choice(["auto", "word", "seg", "delta"]))
+
         def mkpipe(broker, snap=None):
             cfg = Config(
                 bloom_filter_capacity=cap, transport_backend="memory",
                 num_shards=shards, num_replicas=reps,
+                wire_format=wire if not sharded else "auto",
                 snapshot_dir=snap or "",
                 snapshot_every_batches=(int(rng.integers(1, 4))
                                         if snap else 0))
