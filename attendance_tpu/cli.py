@@ -72,31 +72,81 @@ def cmd_process(args) -> None:
         processor.cleanup()
 
 
+def _num_records(records) -> int:
+    """Record count across scan_lecture return shapes (the columnar
+    store returns column dicts, the row stores lists)."""
+    return (len(records["student_id"]) if isinstance(records, dict)
+            else len(records))
+
+
+def _store_for_events_file(config, path: str):
+    """Event store able to read ``path``, sniffing the saved format:
+    the fused pipeline's columnar snapshots are npz (zip magic), the
+    row stores save JSONL. Swaps the configured backend when the flag
+    disagrees with the file."""
+    from attendance_tpu.storage import make_event_store
+
+    store = make_event_store(config)
+    with open(path, "rb") as f:
+        is_npz = f.read(2) == b"PK"
+    is_columnar = hasattr(store, "insert_columns")
+    if is_npz and not is_columnar:
+        from attendance_tpu.storage.columnar_store import (
+            ColumnarEventStore)
+        logger.info("events file is columnar npz; using the "
+                    "columnar store")
+        store = ColumnarEventStore()
+    elif not is_npz and is_columnar:
+        from attendance_tpu.storage.memory_store import MemoryEventStore
+        logger.info("events file is row JSONL; using the row store")
+        store = MemoryEventStore()
+    store.load(path)
+    return store
+
+
+def cmd_stats(args) -> None:
+    """PFCOUNT + partition scan for one lecture — the reference's
+    get_attendance_stats query surface (reference
+    attendance_processor.py:149-165) as a standalone subcommand against
+    the configured sketch/storage backends."""
+    from attendance_tpu.sketch import make_sketch_store
+    from attendance_tpu.storage import make_event_store
+
+    config = config_from_args(args)
+    sketch = make_sketch_store(config)
+    if args.events_file:
+        store = _store_for_events_file(config, args.events_file)
+    else:
+        store = make_event_store(config)
+    unique = sketch.pfcount(
+        f"{config.hll_key_prefix}{args.lecture_id}")
+    records = store.scan_lecture(args.lecture_id)
+    num = _num_records(records)
+    if unique == 0 and num > 0:
+        # Non-persistent sketch backends (tpu/memory) hold HLL state
+        # only in the producing process; answer from the partition
+        # scan instead of reporting a silently-wrong zero.
+        import numpy as np
+
+        sids = (records["student_id"] if isinstance(records, dict)
+                else [r.student_id for r in records])
+        unique = len(np.unique(np.asarray(sids)))
+        logger.info("sketch backend holds no HLL state for this key; "
+                    "unique count derived exactly from the stored "
+                    "partition")
+    print(f"Lecture {args.lecture_id}: {unique} unique attendees, "
+          f"{num} attendance records")
+
+
 def cmd_analyze(args) -> None:
     from attendance_tpu.pipeline.analyzer import AttendanceAnalyzer
     from attendance_tpu.storage import make_event_store
 
     config = config_from_args(args)
-    store = make_event_store(config)
     if args.events_file:
-        # Sniff the format: the fused pipeline's columnar snapshots are
-        # npz (zip magic); the row stores save JSONL. Swap to the store
-        # that can actually read the file when the flag disagrees.
-        with open(args.events_file, "rb") as f:
-            is_npz = f.read(2) == b"PK"
-        is_columnar = hasattr(store, "insert_columns")
-        if is_npz and not is_columnar:
-            from attendance_tpu.storage.columnar_store import (
-                ColumnarEventStore)
-            logger.info("events file is columnar npz; using the "
-                        "columnar store")
-            store = ColumnarEventStore()
-        elif not is_npz and is_columnar:
-            from attendance_tpu.storage.memory_store import (
-                MemoryEventStore)
-            logger.info("events file is row JSONL; using the row store")
-            store = MemoryEventStore()
-        store.load(args.events_file)
+        store = _store_for_events_file(config, args.events_file)
+    else:
+        store = make_event_store(config)
     analyzer = AttendanceAnalyzer(store)
     try:
         analyzer.print_insights(analyzer.generate_insights())
@@ -180,11 +230,9 @@ def cmd_pipeline(args) -> None:
     analyzer.print_insights(analyzer.generate_insights())
     for lecture_id in processor.store.distinct_lecture_ids():
         stats = processor.get_attendance_stats(lecture_id)
-        records = stats["attendance_records"]
-        num = (len(records["student_id"]) if isinstance(records, dict)
-               else len(records))  # columnar scan returns column dicts
         logger.info("%s: %d unique attendees, %d records", lecture_id,
-                    stats["unique_attendees"], num)
+                    stats["unique_attendees"],
+                    _num_records(stats["attendance_records"]))
     processor.cleanup()
 
 
@@ -230,6 +278,16 @@ def main(argv=None) -> None:
     p_an.add_argument("--events-file", default="",
                       help="load events from a saved store file first")
     p_an.set_defaults(fn=cmd_analyze)
+
+    p_st = sub.add_parser(
+        "stats", help="PFCOUNT + partition scan for one lecture "
+        "(the reference's get_attendance_stats query)")
+    add_flags(p_st)
+    p_st.add_argument("lecture_id", help="reference-style lecture id, "
+                      "e.g. LECTURE_20260101")
+    p_st.add_argument("--events-file", default="",
+                      help="load events from a saved store file first")
+    p_st.set_defaults(fn=cmd_stats)
 
     p_pipe = sub.add_parser("pipeline", help="hermetic end-to-end run")
     add_flags(p_pipe)
