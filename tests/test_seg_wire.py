@@ -283,6 +283,58 @@ def test_seg_wire_dedup_ties_keep_append_order():
         assert int(df[df.student_id == 7].event_type.item()) == 1
 
 
+def test_fuzzed_binary_frames_dead_letter_cleanly():
+    """Randomly corrupted/truncated binary frames interleaved with good
+    ones: every corrupt frame must dead-letter (never crash, never
+    livelock) and every good frame must still process — on every wire."""
+
+    rng = np.random.default_rng(21)
+    roster, frames = generate_frames(4096, 512, roster_size=2_000,
+                                     num_lectures=4, seed=2)
+    frames = list(frames)
+    bad = []
+    for f in frames[:4]:
+        buf = bytearray(f)
+        kind = rng.integers(0, 3)
+        if kind == 0:
+            buf = buf[:int(rng.integers(1, len(buf)))]  # truncation
+        elif kind == 1:
+            # Bit flips. ATB2 carries no checksum — payload-only
+            # corruption decodes cleanly — so the magic is corrupted
+            # LAST (random payload flips first, which could otherwise
+            # cancel a same-index header flip) to make the frame
+            # reliably undecodable.
+            for _ in range(7):
+                buf[int(rng.integers(8, min(64, len(buf))))] ^= 0xFF
+            buf[0] ^= 0xFF
+        else:
+            buf = bytearray(b"\x00" * int(rng.integers(1, 40)))
+        bad.append(bytes(buf))
+
+    for wire in ("word", "seg", "delta"):
+        config = Config(bloom_filter_capacity=10_000,
+                        transport_backend="memory", wire_format=wire,
+                        max_redeliveries=1)
+        client = MemoryClient(MemoryBroker())
+        pipe = FusedPipeline(config, client=client, num_banks=4)
+        pipe.preload(roster)
+        producer = client.create_producer(config.pulsar_topic)
+        for good, corrupt in zip(frames, bad + [None] * len(frames)):
+            if corrupt is not None:
+                producer.send(corrupt)
+            producer.send(good)
+        pipe.run(max_events=4096, idle_timeout_s=1.0)
+        assert pipe.metrics.events == 4096, wire
+        # The run can hit max_events with poison redeliveries still
+        # queued; a drain pass must dead-letter them all and leave the
+        # subscription clean.
+        pipe.run(idle_timeout_s=1.0)
+        assert pipe.consumer.backlog() == 0, wire
+        assert pipe.metrics.dead_lettered == len(bad), wire
+        df = pipe.store.to_dataframe(deduplicate=False)
+        assert len(df) == 4096, wire
+
+
 def test_auto_wire_ladder_adapts_to_backpressure():
     """The adaptive ladder must climb (narrower wire) under sustained
     full-deque backpressure, descend under sustained drain, clamp at
