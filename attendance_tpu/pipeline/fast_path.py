@@ -511,9 +511,11 @@ class FusedPipeline:
                 if miss == -1:
                     if use_words:
                         self._kw_hint = kw
+                        self._count_wire("word")
                         self.state, valid = self._word_step(kw)(
                             self.state, jax.numpy.asarray(words))
                     else:
+                        self._count_wire("bytes")
                         self.state, valid = self._step(
                             self.state, jax.numpy.asarray(words))
                     return valid, None
@@ -548,6 +550,7 @@ class FusedPipeline:
         kw = self._pick_kw(int(sid.max()).bit_length(), num_banks)
         if kw + num_banks.bit_length() <= 32 and wire != "bytes":
             self._kw_hint = kw
+            self._count_wire("word")
             words = pack_words(sid, banks, kw, padded)
             self.state, valid = self._word_step(kw)(
                 self.state, jax.numpy.asarray(words))
@@ -555,11 +558,20 @@ class FusedPipeline:
         # ONE combined byte-packed transfer: B little-endian uint32
         # keys then B narrow bank ids (dtype max = padded lane) —
         # (4 + w) bytes/event on the link instead of 8.
+        self._count_wire("bytes")
         buf = pack_bytes(sid, banks, self._bank_dtype, padded)
         self.state, valid = self._step(self.state, jax.numpy.asarray(buf))
         return valid, None
 
     _WIRE_LADDER = ("word", "seg", "delta")
+
+    def _count_wire(self, key: str) -> None:
+        """Record one frame dispatched over ``key`` — called at the
+        dispatch sites themselves, not at wire selection, so fallback
+        frames (narrow wire unavailable, word wire not fitting) are
+        attributed to the wire that actually carried them."""
+        dwell = self.metrics.wire_dwell
+        dwell[key] = dwell.get(key, 0) + 1
 
     def _auto_wire(self) -> str:
         """Per-frame wire choice for auto mode, from observed
@@ -644,6 +656,7 @@ class FusedPipeline:
                         self._db_hint = width
                         step = self._delta_step(width, padded,
                                                 num_banks)
+                    self._count_wire(mode)
                     self.state, valid = step(self.state,
                                              jax.numpy.asarray(buf))
                     return valid, perm, None
@@ -687,6 +700,7 @@ class FusedPipeline:
             buf, perm = pack_delta(sid, banks, db, padded, num_banks,
                                    scan=scan)
             step = self._delta_step(db, padded, num_banks)
+        self._count_wire(mode)
         self.state, valid = step(self.state, jax.numpy.asarray(buf))
         return valid, perm, None
 

@@ -56,6 +56,10 @@ class ProcessorMetrics:
     device_seconds: float = 0.0
     wall_seconds: float = 0.0
     batch_sizes: List[int] = field(default_factory=list)
+    # Frames dispatched per host->device wire (fused path only; the
+    # adaptive ladder makes "which regime did this run measure" a real
+    # observability question).
+    wire_dwell: Dict[str, int] = field(default_factory=dict)
 
     @property
     def events_per_second(self) -> float:

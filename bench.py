@@ -287,11 +287,17 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
         pipe.store.truncate()
     rates.sort()
     median = rates[len(rates) // 2] if rates else 0.0
+    # Which wire the adaptive ladder actually dispatched most frames on
+    # (word/seg/delta/bytes) — the tunnel's momentary link-vs-host
+    # balance decides, so the recorded artifact should say which regime
+    # it measured.
+    dwell = pipe.metrics.wire_dwell or {"word": 0}
     return {
         "events_per_sec": median,
         "events": num_events,
         "rates": [round(r, 1) for r in rates],
         "batch_size": batch_size,
+        "wire": max(dwell, key=dwell.get),
         "elapsed_s": pipe.metrics.wall_seconds,
         "device": str(jax.devices()[0]),
     }
@@ -375,6 +381,7 @@ def main() -> None:
                 "value": round(r["events_per_sec"], 1),
                 "unit": "events/sec",
                 "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+                "wire": r["wire"],
             }
         else:  # both: headline the honest e2e number + kernel alongside
             e2e = bench_e2e(args.e2e_batch_size, args.seconds,
@@ -388,6 +395,7 @@ def main() -> None:
                 "unit": "events/sec",
                 "vs_baseline": round(
                     _vs_baseline(e2e["events_per_sec"]), 4),
+                "wire": e2e["wire"],
                 "kernel_events_per_sec": round(kern["events_per_sec"], 1),
                 "kernel_vs_baseline": round(
                     _vs_baseline(kern["events_per_sec"]), 4),
