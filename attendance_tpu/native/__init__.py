@@ -179,10 +179,11 @@ class HostPipe:
                    lut: np.ndarray, day_base: int, db_hint: int,
                    padded: int, num_banks: int):
         """Fused LUT map + (bank, key) sort + delta emit + bit-pack
-        (models.fused delta wire). Returns (buf, perm, db, -1) on
-        success — db is max(db_hint, the frame's needed width) — or
-        (None, None, 0, miss_index) on a LUT miss / (None, None, 0, -2)
-        when the native pass can't run."""
+        (models.fused delta wire). Returns (buf, perm, db, needed, -1)
+        on success — db is the packed width (>= db_hint, rounded even)
+        and needed the frame's own minimum, which callers use to decay
+        a stale-high hint — or (None, None, 0, 0, miss_index) on a LUT
+        miss / (None, None, 0, 0, -2) when the native pass can't run."""
         from attendance_tpu.models.fused import delta_buf_words
 
         kp, ks = self._strided(keys)
@@ -199,9 +200,9 @@ class HostPipe:
             _ptr(counts, _u32p), _ptr(bases, _u32p), _ptr(deltas, _u32p),
             _ptr(perm, _u32p), _ptr(needed, _u32p))
         if rc > 0:
-            return None, None, 0, int(rc - 1)
+            return None, None, 0, 0, int(rc - 1)
         if rc < 0:
-            return None, None, 0, -2
+            return None, None, 0, 0, -2
         from attendance_tpu.models.fused import pick_delta_width
 
         db = pick_delta_width(db_hint, int(needed[0]))
@@ -213,8 +214,8 @@ class HostPipe:
             _ptr(buf[2 * num_banks:], _u32p),
             len(buf) - 2 * num_banks)
         if rc < 0:
-            return None, None, 0, -2
-        return buf, perm[:n], db, -1
+            return None, None, 0, 0, -2
+        return buf, perm[:n], db, int(needed[0]), -1
 
     def prepare_json_batch(self, payloads) -> "PreparedJsonBatch":
         """One-time O(total bytes) setup for a batch of JSON payloads;

@@ -177,6 +177,12 @@ class FusedPipeline:
             # programs, not one per frame.
             self._delta_steps: Dict[tuple, object] = {}
             self._db_hint = 1
+            # Decay bookkeeping: frames whose own needed width sits
+            # well under the hint, and the widest such width seen. A
+            # transient outlier frame must not pin the delta wire wide
+            # forever (every extra bit is link bytes).
+            self._db_slack = 0
+            self._db_seen = 1
             self._kw_hint = 1
             # Adaptive wire ladder for auto mode (see _auto_wire):
             # 0 = word (cheapest host pack), 1 = seg, 2 = delta
@@ -342,10 +348,13 @@ class FusedPipeline:
                     # Packed word wire onto the mesh: 4 B/event per
                     # chip instead of the 9 of keys + bank ids + mask.
                     self._kw_hint = kw
+                    self._count_wire("word")
                     words = pack_words(sid, banks, kw,
                                        self.engine.padded_size(n))
                     valid_n = self.engine.step_words(words, n, kw)
                 else:
+                    # Separate key/bank/mask arrays (9 B/event).
+                    self._count_wire("arrays")
                     valid_n = self.engine.step(sid, banks)
             stored = valid_n
         else:
@@ -386,6 +395,28 @@ class FusedPipeline:
                 self.params, kb, padded, num_banks,
                 self.config.hll_precision)
         return step
+
+    def _decayed_db(self, width: int, needed: int) -> int:
+        """Next delta-width hint after a frame packed at ``width``
+        whose own minimum was ``needed``.
+
+        Growth is immediate (width already includes it). Decay needs
+        evidence: 16 consecutive frames with >= 3 bits of slack drop
+        the hint to the widest width those frames actually needed —
+        so one pathological frame widens the wire once, not forever,
+        while steady populations never oscillate (the 3-bit guard band
+        absorbs ordinary widest-gap jitter, and each decay step is a
+        new compile, so it must be rare)."""
+        if needed <= width - 3:
+            self._db_slack += 1
+            self._db_seen = max(self._db_seen, needed)
+            if self._db_slack >= 16:
+                from attendance_tpu.models.fused import pick_delta_width
+                width = pick_delta_width(1, self._db_seen)
+                self._db_slack, self._db_seen = 0, 1
+        else:
+            self._db_slack, self._db_seen = 0, 1
+        return width
 
     def _delta_step(self, db: int, padded: int, num_banks: int):
         key = (db, padded, num_banks)
@@ -645,7 +676,7 @@ class FusedPipeline:
                             sid, days, self._day_lut, self._day_base,
                             width, padded, num_banks)
                 else:
-                    buf, perm, width, miss = nat.pack_delta(
+                    buf, perm, width, needed, miss = nat.pack_delta(
                         sid, days, self._day_lut, self._day_base,
                         self._db_hint, padded, num_banks)
                 if miss == -1:
@@ -653,7 +684,7 @@ class FusedPipeline:
                         self._kw_hint = width
                         step = self._seg_step(width, padded, num_banks)
                     else:
-                        self._db_hint = width
+                        self._db_hint = self._decayed_db(width, needed)
                         step = self._delta_step(width, padded,
                                                 num_banks)
                     self._count_wire(mode)
@@ -696,7 +727,7 @@ class FusedPipeline:
         else:
             scan = delta_scan(sid, banks, num_banks)
             db = pick_delta_width(self._db_hint, scan[-1])
-            self._db_hint = db
+            self._db_hint = self._decayed_db(db, scan[-1])
             buf, perm = pack_delta(sid, banks, db, padded, num_banks,
                                    scan=scan)
             step = self._delta_step(db, padded, num_banks)

@@ -79,12 +79,14 @@ class ProcessorMetrics:
                     f"{self.invalid_events} invalid"
                     if include_validity
                     else "validity in store (async)")
+        wires = ("" if not self.wire_dwell else "; wires " + ",".join(
+            f"{k}:{v}" for k, v in sorted(self.wire_dwell.items())))
         return (f"{self.events} events in {self.batches} batches "
                 f"({self.events_per_second:.0f} ev/s; mean batch "
                 f"{mean_batch:.0f}; device {self.device_seconds:.3f}s; "
                 f"est. bloom FPR {fpr}; {validity}, "
                 f"{self.nacked_batches} nacked, {self.dead_lettered} "
-                f"dead-lettered)")
+                f"dead-lettered{wires})")
 
 
 class AttendanceProcessor:

@@ -168,10 +168,11 @@ def test_pack_delta_native_matches_numpy():
         days = (day_base + banks).astype(np.uint32)
         lut = np.full(16384, -1, np.int32)
         lut[:num_banks] = np.arange(num_banks)
-        buf_c, perm_c, db, miss = nat.pack_delta(
+        buf_c, perm_c, db, needed_c, miss = nat.pack_delta(
             keys, days, lut, day_base, 1, padded, num_banks)
         assert miss == -1
         *_, needed = delta_scan(keys, banks, num_banks)
+        assert needed_c == needed
         assert needed <= db <= 32
         buf_np, perm_np = pack_delta(keys, banks, db, padded, num_banks)
         np.testing.assert_array_equal(perm_c, perm_np)
@@ -179,8 +180,8 @@ def test_pack_delta_native_matches_numpy():
     # equal (bank, key) events keep append order (dedup tie contract)
     keys = np.array([5, 5, 5, 9, 5], np.uint32)
     days = np.full(5, day_base, np.uint32)
-    _, perm_c, _, miss = nat.pack_delta(keys, days, lut, day_base, 1,
-                                        256, 1)
+    _, perm_c, _, _, miss = nat.pack_delta(keys, days, lut, day_base,
+                                           1, 256, 1)
     assert miss == -1 and list(perm_c) == [0, 1, 2, 4, 3]
 
 
@@ -281,6 +282,45 @@ def test_seg_wire_dedup_ties_keep_append_order():
         # Last write wins: student 7's surviving row is the LAST
         # appended one (event_type exit).
         assert int(df[df.student_id == 7].event_type.item()) == 1
+
+
+def test_delta_width_hint_decays_after_outlier():
+    """One frame with huge sorted-key gaps must not pin the delta wire
+    wide forever: after 16 consecutive narrow frames the width hint
+    drops back to what the recent population needs."""
+    from attendance_tpu.pipeline.loadgen import frame_from_columns
+
+    def frame(keys):
+        n = len(keys)
+        return frame_from_columns({
+            "student_id": np.asarray(keys, np.uint32),
+            "lecture_day": np.full(n, 20260101, np.uint32),
+            "micros": np.arange(n, dtype=np.int64),
+            "is_valid": np.ones(n, bool),
+            "event_type": np.zeros(n, np.int8),
+        })
+
+    rng = np.random.default_rng(13)
+    wide = rng.choice(1 << 22, 300, replace=False).astype(np.uint32)
+    narrow = (10_000 + rng.choice(2_000, 300,
+                                  replace=False)).astype(np.uint32)
+    config = Config(bloom_filter_capacity=10_000,
+                    transport_backend="memory", wire_format="delta")
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=4)
+    pipe.preload(narrow)
+    producer = client.create_producer(config.pulsar_topic)
+    producer.send(frame(wide))
+    pipe.run(max_events=300, idle_timeout_s=0.5)
+    wide_hint = pipe._db_hint
+    assert wide_hint >= 10  # 300 keys over 2^22: double-digit gaps
+    for _ in range(20):
+        producer.send(frame(narrow))
+    pipe.run(max_events=300 * 21, idle_timeout_s=0.5)
+    assert pipe._db_hint < wide_hint
+    # Accuracy unaffected throughout: every narrow-roster event valid.
+    sv = np.asarray(pipe.store.to_columns(deduplicate=False)["is_valid"])
+    assert sv[300:].all()
 
 
 def test_fuzzed_binary_frames_dead_letter_cleanly():
