@@ -192,6 +192,7 @@ class FusedPipeline:
             # committing to either.
             self._auto_level = 0
             self._auto_pressure = 0
+            self._drain_waited = False
             # Native host runtime (fused decode+LUT+pack pass); None
             # falls back to the numpy path transparently. _native_skip
             # adaptively bypasses doomed native attempts when the
@@ -628,11 +629,16 @@ class FusedPipeline:
         """
         if self.checkpointing:
             return self._WIRE_LADDER[self._auto_level]
-        depth = len(self._inflight)
-        if depth >= _INFLIGHT_DEPTH - 1:
+        # Primary signal: the hot loop actually BLOCKED on a full deque
+        # since the last frame (set by _drain_inflight) — the tunnel
+        # completes transfers in bursts, so instantaneous depth
+        # oscillates 0..full and washes out, while a forced wait is
+        # unambiguous "device/link behind".
+        if self._drain_waited:
             self._auto_pressure = min(self._auto_pressure + 1, 8)
-        elif depth <= 1:
+        elif len(self._inflight) <= 1:
             self._auto_pressure = max(self._auto_pressure - 1, -8)
+        self._drain_waited = False
         # Asymmetric hysteresis: a full deque means dispatches are
         # cheap to divert into a narrower pack (climb after 2 signals),
         # while descending costs re-paying link bytes — require
@@ -855,6 +861,13 @@ class FusedPipeline:
                 if not ready:
                     if block == 0:
                         break
+                    if block > 0:
+                        # The hot loop is stalled on a full deque: the
+                        # device/link side is definitively behind. This
+                        # is _auto_wire's climb signal — instantaneous
+                        # deque depth oscillates under the tunnel's
+                        # bursty completion and washes out.
+                        self._drain_waited = True
                     jax.block_until_ready(valid)
                     if block > 0:
                         block -= 1

@@ -383,34 +383,37 @@ def test_auto_wire_ladder_adapts_to_backpressure():
     client = MemoryClient(MemoryBroker())
     pipe = FusedPipeline(config, client=client, num_banks=8)
 
-    def drive(depth, frames):
+    def drive(frames, waited=False, depth=4):
+        """Simulate `frames` frames: `waited` = the hot loop blocked on
+        a full deque since the last frame (the climb signal); `depth` =
+        deque depth at dispatch (<=1 is the descend signal)."""
         pipe._inflight.clear()
         pipe._inflight.extend([(None, None)] * depth)
-        return [pipe._auto_wire() for _ in range(frames)]
+        out = []
+        for _ in range(frames):
+            pipe._drain_waited = waited
+            out.append(pipe._auto_wire())
+        return out
 
     assert pipe._auto_level == 0
-    # Two full-deque signals climb one level; sustained pressure tops
+    # Two forced-wait signals climb one level; sustained pressure tops
     # out at the ladder's end and stays clamped there.
-    seen = drive(8, 2)
+    seen = drive(2, waited=True)
     assert pipe._auto_level == 1 and seen[-1] == "seg"
-    drive(8, 20)
-    assert pipe._auto_level == 2 and pipe._auto_wire() == "delta"
-    # Descent needs six drain signals per level, clamps at word.
-    pipe._inflight.clear()
-    seen = [pipe._auto_wire() for _ in range(5)]
+    drive(20, waited=True)
+    assert pipe._auto_level == 2 and drive(1, waited=True) == ["delta"]
+    # Descent needs six drained-empty signals per level, clamps at word.
+    seen = drive(5, depth=0)
     assert pipe._auto_level == 2  # not yet
-    for _ in range(30):
-        pipe._auto_wire()
-    assert pipe._auto_level == 0 and pipe._auto_wire() == "word"
-    # Mid-depth frames are neutral: no drift in either direction.
+    drive(30, depth=0)
+    assert pipe._auto_level == 0 and drive(1, depth=0) == ["word"]
+    # Mid-depth frames with no forced wait are neutral: no drift.
     pipe._auto_level, pipe._auto_pressure = 1, 0
-    drive(4, 50)
+    drive(50, depth=4)
     assert pipe._auto_level == 1
     # Checkpointing freezes adaptation at the current level.
     pipe._snap_dir = object()
-    pipe._inflight.clear()
-    pipe._inflight.extend([(None, None)] * 8)
-    assert [pipe._auto_wire() for _ in range(10)] == ["seg"] * 10
+    assert drive(10, waited=True, depth=8) == ["seg"] * 10
     assert pipe._auto_level == 1 and pipe._auto_pressure == 0
 
 
