@@ -122,20 +122,25 @@ def cmd_stats(args) -> None:
         f"{config.hll_key_prefix}{args.lecture_id}")
     records = store.scan_lecture(args.lecture_id)
     num = _num_records(records)
+    source = "HLL estimate"
     if unique == 0 and num > 0:
         # Non-persistent sketch backends (tpu/memory) hold HLL state
         # only in the producing process; answer from the partition
-        # scan instead of reporting a silently-wrong zero.
+        # scan instead of reporting a silently-wrong zero. The printed
+        # line marks the source so a consumer can tell this exact
+        # fallback from a sketch estimate (the reference always reports
+        # the sketch value, attendance_processor.py:151-152).
         import numpy as np
 
         sids = (records["student_id"] if isinstance(records, dict)
                 else [r.student_id for r in records])
         unique = len(np.unique(np.asarray(sids)))
+        source = "exact, from stored partition; no HLL state"
         logger.info("sketch backend holds no HLL state for this key; "
                     "unique count derived exactly from the stored "
                     "partition")
-    print(f"Lecture {args.lecture_id}: {unique} unique attendees, "
-          f"{num} attendance records")
+    print(f"Lecture {args.lecture_id}: {unique} unique attendees "
+          f"({source}), {num} attendance records")
 
 
 def cmd_analyze(args) -> None:
@@ -237,20 +242,30 @@ def cmd_pipeline(args) -> None:
 
 
 def cmd_parity(args) -> None:
-    """Differential tpu-vs-redis parity run against a real Redis Stack."""
+    """Differential tpu-vs-oracle parity run.
+
+    ``--oracle redis`` pairs the TPU store against a live Redis Stack
+    (exits 2 when none is reachable); ``--oracle sim`` (default) pairs
+    it against the hermetic simulation of Redis's algorithms
+    (sketch.redis_sim) — same harness, no server needed.
+    """
     import sys
 
-    from attendance_tpu.parity import RedisUnavailable, run_redis_parity
+    from attendance_tpu.parity import (
+        RedisUnavailable, run_redis_parity, run_sim_parity)
 
     config = config_from_args(args)
-    try:
-        report = run_redis_parity(
-            config, num_events=args.num_events,
-            roster_size=args.roster_size,
-            num_lectures=args.num_lectures, seed=args.seed)
-    except RedisUnavailable as e:
-        logger.error("parity run needs a Redis Stack server: %s", e)
-        sys.exit(2)
+    kwargs = dict(num_events=args.num_events,
+                  roster_size=args.roster_size,
+                  num_lectures=args.num_lectures, seed=args.seed)
+    if args.oracle == "redis":
+        try:
+            report = run_redis_parity(config, **kwargs)
+        except RedisUnavailable as e:
+            logger.error("parity run needs a Redis Stack server: %s", e)
+            sys.exit(2)
+    else:
+        report = run_sim_parity(config, **kwargs)
     print(report.summary())
     if not report.ok:
         sys.exit(1)
@@ -316,9 +331,13 @@ def main(argv=None) -> None:
     p_br.set_defaults(fn=cmd_bridge)
 
     p_par = sub.add_parser(
-        "parity", help="differential tpu-vs-redis accuracy check "
-        "(exits 2 when no Redis Stack is reachable)")
+        "parity", help="differential tpu-vs-oracle accuracy check "
+        "(--oracle sim is hermetic; --oracle redis needs a Redis Stack "
+        "and exits 2 when none is reachable)")
     add_flags(p_par)
+    p_par.add_argument("--oracle", choices=["sim", "redis"], default="sim",
+                       help="sim = hermetic Redis-algorithm simulation "
+                       "(sketch.redis_sim); redis = live Redis Stack")
     p_par.add_argument("--num-events", type=int, default=50_000)
     p_par.add_argument("--roster-size", type=int, default=10_000)
     p_par.add_argument("--num-lectures", type=int, default=4)

@@ -110,7 +110,8 @@ class Config:
     max_redeliveries: int = 3
 
     def validate(self) -> "Config":
-        if self.sketch_backend not in ("tpu", "memory", "redis"):
+        if self.sketch_backend not in ("tpu", "memory", "redis",
+                                       "redis-sim"):
             raise ValueError(f"unknown sketch backend: {self.sketch_backend}")
         if self.bloom_layout not in ("flat", "blocked"):
             raise ValueError(f"unknown bloom layout: {self.bloom_layout}")
@@ -134,9 +135,12 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
     """Register framework flags on an argparse parser."""
     p = parser or argparse.ArgumentParser(description="attendance_tpu")
     d = DEFAULT_CONFIG
-    p.add_argument("--sketch-backend", choices=["redis", "tpu", "memory"],
+    p.add_argument("--sketch-backend",
+                   choices=["redis", "tpu", "memory", "redis-sim"],
                    default=d.sketch_backend,
-                   help="execution backend for BF.*/PFADD/PFCOUNT")
+                   help="execution backend for BF.*/PFADD/PFCOUNT "
+                   "(redis-sim = hermetic simulation of Redis's "
+                   "algorithms, the server-free parity oracle)")
     p.add_argument("--transport-backend", choices=["memory", "pulsar"],
                    default=d.transport_backend)
     p.add_argument("--storage-backend",

@@ -319,7 +319,18 @@ def pack_seg(keys, banks, kb: int, padded: int, num_banks: int):
     buf = np.zeros(seg_buf_words(num_banks, kb, padded), np.uint32)
     buf[:num_banks] = counts
     if n:
-        sk = np.asarray(keys, np.uint32)[perm].astype(np.uint64)
+        keys_u32 = np.asarray(keys, np.uint32)
+        if kb < 32 and int(keys_u32.max()) >> kb:
+            # A key wider than kb bits would silently OR-spill into the
+            # next lane's bitstream positions. The native packer refuses
+            # (rc=-3) and pack_delta returns None; mirror that contract
+            # instead of corrupting the neighbor lane. Callers deriving
+            # kb from the frame's max key never hit this; a stale width
+            # hint must fail loudly.
+            raise ValueError(
+                f"pack_seg: key exceeds {kb}-bit width "
+                f"(max key {int(keys_u32.max())})")
+        sk = keys_u32[perm].astype(np.uint64)
         pos = np.arange(n, dtype=np.uint64) * np.uint64(kb)
         w0 = (pos >> np.uint64(5)).astype(np.int64) + num_banks
         sh = pos & np.uint64(31)

@@ -70,8 +70,13 @@ def build(force: bool = False) -> Optional[Path]:
         logger.info("native hostpipe: cache dir not writable (%s); "
                     "using numpy (set ATP_NATIVE_CACHE to override)", exc)
         return None
+    # -pthread (not just -lpthread): the scratch arena uses pthread TSD
+    # (pthread_key_create & co); without it the link can succeed with
+    # undefined symbols that only resolve when libpthread already sits
+    # in the process's global scope — dlopen would then fail exactly for
+    # the out-of-CPython embedders the TSD destructor exists for.
     cmd = [cc, "-O3", "-march=native", "-std=c17", "-shared", "-fPIC",
-           "-o", tmp, str(_SRC)]
+           "-pthread", "-o", tmp, str(_SRC)]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)

@@ -24,6 +24,7 @@
  * frames (stride 20).
  */
 
+#include <pthread.h>
 #include <stddef.h>
 #include <stdint.h>
 #include <stdlib.h>
@@ -32,22 +33,49 @@
 /* Per-thread scratch arena: the packers need several MB of working
  * memory per frame, and a fresh malloc each call costs more in page
  * faults than the passes that use it (measured ~5ms of a 7ms 512k-event
- * delta scan).  Slots grow monotonically and are never freed, so the
- * bound is PER THREAD (largest frame that thread ever packs) and the
- * arena leaks when its thread exits — callers that pack from
- * short-lived worker threads should pack from a long-lived one
- * instead (the pipeline drives all packs from its run loop thread). */
+ * delta scan).  Slots grow monotonically while a thread lives, so the
+ * bound is PER THREAD (largest frame that thread ever packs); a
+ * pthread TSD destructor frees the whole arena at thread exit, so
+ * embedders packing from short-lived worker threads do not leak
+ * (ADVICE r02). The arena struct is heap-owned and reached through the
+ * TSD key — never through __thread storage, whose teardown order
+ * against TSD destructors is unspecified. */
 enum { SCRATCH_SLOTS = 6 };
-static __thread struct { void *p; size_t cap; } g_scratch[SCRATCH_SLOTS];
+
+typedef struct { void *p; size_t cap; } scratch_slot;
+typedef struct { scratch_slot s[SCRATCH_SLOTS]; } scratch_arena;
+
+static pthread_key_t g_scratch_key;
+static pthread_once_t g_scratch_once = PTHREAD_ONCE_INIT;
+
+static void scratch_destroy(void *arg) {
+    scratch_arena *a = arg;
+    for (int i = 0; i < SCRATCH_SLOTS; ++i) free(a->s[i].p);
+    free(a);
+}
+
+static void scratch_key_init(void) {
+    (void)pthread_key_create(&g_scratch_key, scratch_destroy);
+}
 
 static void *scratch(int slot, size_t bytes) {
-    if (g_scratch[slot].cap < bytes) {
-        void *np_ = realloc(g_scratch[slot].p, bytes);
-        if (!np_) return NULL;
-        g_scratch[slot].p = np_;
-        g_scratch[slot].cap = bytes;
+    pthread_once(&g_scratch_once, scratch_key_init);
+    scratch_arena *a = pthread_getspecific(g_scratch_key);
+    if (!a) {
+        a = calloc(1, sizeof *a);
+        if (!a) return NULL;
+        if (pthread_setspecific(g_scratch_key, a) != 0) {
+            free(a);
+            return NULL;
+        }
     }
-    return g_scratch[slot].p;
+    if (a->s[slot].cap < bytes) {
+        void *np_ = realloc(a->s[slot].p, bytes);
+        if (!np_) return NULL;
+        a->s[slot].p = np_;
+        a->s[slot].cap = bytes;
+    }
+    return a->s[slot].p;
 }
 
 /* Strided uint32 load: byte base + element index * byte stride. */

@@ -193,6 +193,10 @@ class FusedPipeline:
             self._auto_level = 0
             self._auto_pressure = 0
             self._drain_waited = False
+            # One-time notice when a FORCED word wire cannot be honored
+            # (key+bank bits exceed a word) and frames degrade to the
+            # bytes wire — without it only wire_dwell reveals the switch.
+            self._warned_word_degrade = False
             # Native host runtime (fused decode+LUT+pack pass); None
             # falls back to the numpy path transparently. _native_skip
             # adaptively bypasses doomed native attempts when the
@@ -537,6 +541,7 @@ class FusedPipeline:
                                 sid, days, self._day_lut,
                                 self._day_base, kw, padded)
                 if not use_words:
+                    self._note_word_degrade()
                     words, miss = nat.pack_bytes(
                         sid, days, self._day_lut, self._day_base,
                         np.dtype(self._bank_dtype).itemsize, padded)
@@ -590,10 +595,24 @@ class FusedPipeline:
         # ONE combined byte-packed transfer: B little-endian uint32
         # keys then B narrow bank ids (dtype max = padded lane) —
         # (4 + w) bytes/event on the link instead of 8.
+        self._note_word_degrade()
         self._count_wire("bytes")
         buf = pack_bytes(sid, banks, self._bank_dtype, padded)
         self.state, valid = self._step(self.state, jax.numpy.asarray(buf))
         return valid, None
+
+    def _note_word_degrade(self) -> None:
+        """Log ONCE when ``--wire-format=word`` was requested but a
+        frame's key + bank bits exceed 32 and it must ride the bytes
+        wire instead — a forced format is otherwise silently unhonored
+        (only wire_dwell would reveal it)."""
+        if (self.config.wire_format == "word"
+                and not self._warned_word_degrade):
+            self._warned_word_degrade = True
+            logger.warning(
+                "--wire-format=word cannot be honored: key bits + bank "
+                "bits exceed one 32-bit word; frames fall back to the "
+                "bytes wire (see metrics wire_dwell for the split)")
 
     _WIRE_LADDER = ("word", "seg", "delta")
 
@@ -799,6 +818,22 @@ class FusedPipeline:
             regs = data["hll_regs"]
             counts = (data["counts"] if "counts" in data
                       else np.zeros((2, 2), np.uint32))
+            # The bank map must be consistent with the register banks it
+            # routes into — a stale/hand-edited manifest that references
+            # banks beyond the restored array would silently misroute
+            # every PFADD for those days. Fail loudly instead.
+            bank_vals = [int(b) for b in manifest["bank_of"].values()]
+            if bank_vals:
+                if len(set(bank_vals)) != len(bank_vals):
+                    raise ValueError(
+                        "snapshot manifest maps two days to one HLL bank"
+                        " — manifest is corrupt")
+                if max(bank_vals) >= regs.shape[0]:
+                    raise ValueError(
+                        f"snapshot manifest references bank "
+                        f"{max(bank_vals)} but only {regs.shape[0]} "
+                        "register banks were restored — manifest and "
+                        "registers are from different snapshots")
         if self.sharded:
             self.engine.set_state(bits, regs)
         else:

@@ -7,10 +7,14 @@ shapes — ``execute_command('BF.ADD'|'BF.EXISTS'|'BF.RESERVE', ...)``,
 API-stable across three interchangeable backends selected by
 ``--sketch-backend``:
 
-  * "tpu"    — device-resident sketches, micro-batched JAX kernels
-  * "memory" — pure-host numpy sketches, bit-identical hashing (hermetic
-               tests + differential oracle for the device path)
-  * "redis"  — real Redis Stack via redis-py (import-gated)
+  * "tpu"       — device-resident sketches, micro-batched JAX kernels
+  * "memory"    — pure-host numpy sketches, bit-identical hashing
+                  (hermetic tests + differential oracle for the device
+                  path)
+  * "redis"     — real Redis Stack via redis-py (import-gated)
+  * "redis-sim" — hermetic simulation of Redis's actual algorithms
+                  (RedisBloom sizing + MurmurHash64A double hashing,
+                  dense-HLL hllPatLen); the server-free parity oracle
 """
 
 from attendance_tpu.sketch.base import (  # noqa: F401
@@ -28,4 +32,7 @@ def make_sketch_store(config) -> SketchStore:
     if config.sketch_backend == "redis":
         from attendance_tpu.sketch.redis_store import RedisSketchStore
         return RedisSketchStore(config)
+    if config.sketch_backend == "redis-sim":
+        from attendance_tpu.sketch.redis_sim import RedisSimSketchStore
+        return RedisSimSketchStore(config)
     raise ValueError(f"unknown sketch backend {config.sketch_backend!r}")

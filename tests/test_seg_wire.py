@@ -444,3 +444,18 @@ def test_seg_wire_out_of_window_days_fall_back():
         assert len(df) == n
         assert bool(df.is_valid.all())  # whole roster preloaded
         assert sorted(pipe.lecture_days()) == [20260101, 100_000_777]
+
+
+def test_pack_seg_numpy_rejects_overflowing_keys():
+    """A key wider than kb bits must raise, not OR-spill into the next
+    lane's bitstream (ADVICE r02: mirror the native packer's rc=-3 and
+    pack_delta's needed>db refusal)."""
+    import pytest
+
+    keys = np.array([5, 1 << 20, 9], dtype=np.uint32)  # 21-bit key
+    banks = np.zeros(3, dtype=np.int32)
+    with pytest.raises(ValueError, match="width"):
+        pack_seg(keys, banks, kb=10, padded=256, num_banks=4)
+    # Deriving kb from the frame's own max key always succeeds.
+    buf, perm = pack_seg(keys, banks, kb=21, padded=256, num_banks=4)
+    assert buf is not None and len(perm) == 3
