@@ -232,7 +232,12 @@ class ShardedSketchEngine:
 
         def query_kernel(bits_loc, keys):
             partial = local_contains(bits_loc, keys)
-            return jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
+            valid = jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
+            # contains() is a host-read API: gather the dp-sharded
+            # answer so the output is fully replicated — on a
+            # multi-host mesh a dp-sharded output would span
+            # non-addressable devices and be unreadable.
+            return jax.lax.all_gather(valid, "dp", tiled=True)
 
         def hist_kernel(regs_loc):
             """Full register histogram per bank: replica max-union across
@@ -250,8 +255,13 @@ class ShardedSketchEngine:
         # Device-side replica merge for host reads: ships 1x the
         # register state over the host link instead of all dp private
         # copies (D2H volume is the expensive resource — see the
-        # platform notes in pipeline.fast_path.run).
-        self._merge_regs = jax.jit(lambda r: jnp.max(r, axis=0))
+        # platform notes in pipeline.fast_path.run). The output is
+        # pinned fully replicated so get_state works on a multi-host
+        # mesh (an inferred sharding could leave it spanning
+        # non-addressable devices).
+        self._merge_regs = jax.jit(
+            lambda r: jnp.max(r, axis=0),
+            out_shardings=NamedSharding(mesh, P(None, None)))
         # check_vma=False: the all_gather+OR leaves every dp replica with
         # the identical union filter, but the static varying-axes checker
         # cannot infer that replication through the elementwise ORs.
@@ -266,8 +276,12 @@ class ShardedSketchEngine:
             in_specs=(P("sp"), regs_spec, P("dp"), P("dp"), P("dp")),
             out_specs=(P("dp"), regs_spec)),
             donate_argnums=(1,))
+        # check_vma=False: like the preload's all_gather+OR, the static
+        # varying-axes checker cannot infer that pmin + tiled all_gather
+        # leave every device with the identical vector.
         self._query = jax.jit(smap(
-            query_kernel, in_specs=(P("sp"), P("dp")), out_specs=P("dp")))
+            query_kernel, in_specs=(P("sp"), P("dp")),
+            out_specs=P(None), check_vma=False))
         self._hist = jax.jit(smap(
             hist_kernel, in_specs=(regs_spec,), out_specs=P(None)))
 

@@ -1,0 +1,136 @@
+"""Worker script + shared workload for the 2-process DCN test.
+
+Run as a subprocess by tests/test_multihost.py (never collected by
+pytest — the name has no ``test_`` prefix):
+
+    python tests/multihost_worker.py <process_id> <num_processes> \
+        <coordinator_port> <out_json>
+
+Each process joins a real ``jax.distributed`` CPU cluster (4 virtual
+devices per process, gloo collectives over localhost TCP) and drives
+the IDENTICAL deterministic workload through a
+``make_multihost_mesh(num_shards=4)`` engine — dp spans the process
+boundary, so every deferred-sync pmax/psum and the preload's
+all-gather-OR actually cross "DCN". Results are written as JSON for
+the test to compare against the single-process answer (the analogue of
+the reference's competing consumers on one Pulsar Shared subscription,
+reference attendance_processor.py:30-34).
+
+Multi-controller convention: every process feeds the same full host
+batch (numpy arrays; jit shards them over the mesh), and every process
+executes the same program — the per-step validity AND rides "sp"
+(intra-host), the replica union "dp" (cross-host).
+"""
+
+import hashlib
+import json
+import sys
+
+
+def run_workload(mesh) -> dict:
+    """The deterministic workload both the 2-process cluster and the
+    single-process reference execute; returns JSON-serializable facts
+    that must agree bit-for-bit across the two executions."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from attendance_tpu.parallel.sharded import ShardedSketchEngine
+
+    engine = ShardedSketchEngine(
+        mesh, capacity=20_000, error_rate=0.01, num_banks=8,
+        precision=14, layout="blocked", replica_sync="query")
+
+    rng = np.random.default_rng(42)
+    roster = np.arange(10_000, 30_000, dtype=np.uint32)
+    engine.preload(roster)
+
+    # 4 mixed batches: ~85% roster members, rest from a disjoint range.
+    nvalid_total = 0
+    total = 0
+    exact = [set() for _ in range(8)]
+    for step_i in range(4):
+        n = 4096
+        take = rng.random(n) < 0.85
+        keys = np.where(take, roster[rng.integers(0, len(roster), n)],
+                        rng.integers(50_000, 80_000, n)).astype(np.uint32)
+        banks = rng.integers(0, 8, n).astype(np.int32)
+        if step_i % 2 == 0:
+            valid = engine.step(keys, banks)
+        else:
+            # The packed word wire over the mesh (kw=17 covers 30k ids).
+            kw = 17
+            padded = engine.padded_size(n)
+            words = np.full(padded, 0xFFFFFFFF, np.uint32)
+            words[:n] = (banks.astype(np.uint32) << kw) | keys
+            valid = engine.step_words(words, n, kw)
+        # Device-side reduction: the validity vector is dp-sharded
+        # across processes, so only collectively-reduced scalars (and
+        # fully-replicated outputs) are host-readable.
+        nvalid_total += int(jax.jit(lambda v: jnp.sum(v.astype(jnp.int32))
+                                    )(valid))
+        total += n
+        vmask = take  # ground truth (disjoint ranges, no FN possible)
+        for b in range(8):
+            exact[b].update(keys[vmask & (banks == b)].tolist())
+
+    counts = [int(c) for c in engine.count_all()]
+    # Membership over a fixed probe set (output of contains() is
+    # host-materialized inside the engine — replicated across dp).
+    probe = np.concatenate([roster[:512],
+                            np.arange(60_000, 60_512, dtype=np.uint32)])
+    member = engine.contains(probe)
+    bits, regs = engine.get_state()
+    return {
+        "nvalid_total": nvalid_total,
+        "total": total,
+        "counts": counts,
+        "exact": [len(s) for s in exact],
+        "member_roster": int(member[:512].sum()),
+        "member_invalid": int(member[512:].sum()),
+        "bloom_sha": hashlib.sha256(bits.tobytes()).hexdigest(),
+        "regs_sha": hashlib.sha256(regs.tobytes()).hexdigest(),
+    }
+
+
+def main() -> None:
+    proc_id, num_procs = int(sys.argv[1]), int(sys.argv[2])
+    port, out_path = sys.argv[3], sys.argv[4]
+
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_procs, process_id=proc_id)
+
+    from attendance_tpu.parallel import multihost
+    # The module-level guard must report the already-initialized
+    # multi-process runtime (the FusedPipeline path calls it blindly).
+    multihost._init_attempted = True
+    assert multihost.init_distributed() is True
+    assert jax.process_count() == num_procs
+
+    # The DCN branch under test (parallel/multihost.py n_procs>1):
+    # sp=4 fills each host's devices, dp=2 spans the process boundary.
+    mesh = multihost.make_multihost_mesh(num_shards=4)
+    assert dict(mesh.shape) == {"dp": num_procs, "sp": 4}, mesh.shape
+
+    # The straddle invariant: 3 shards cannot divide 4 local devices.
+    try:
+        multihost.make_multihost_mesh(num_shards=3)
+        raise AssertionError("straddling mesh must be rejected")
+    except ValueError:
+        pass
+
+    result = run_workload(mesh)
+    result["process_id"] = proc_id
+    result["process_count"] = jax.process_count()
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+    print(f"[p{proc_id}] OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
