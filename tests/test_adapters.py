@@ -1,0 +1,475 @@
+"""Hermetic coverage of the import-gated service adapters (VERDICT r02 #6).
+
+None of redis-py / cassandra-driver / pulsar-client exist in this
+environment, so the three adapters (`sketch/redis_store.py`,
+`storage/cassandra_store.py`, `transport/pulsar_client.py`) shipped
+with zero executed lines — a typo in the CQL or a wrong pipeline call
+would ship green. These tests inject faithful fake client modules into
+``sys.modules`` and execute every adapter line against them:
+
+* fake ``redis`` — a client whose server side IS RedisSimSketchStore
+  (Redis's actual algorithms), with a command-recording pipeline();
+  drives the whole redis plumbing of the parity harness
+  (check_redis + run_redis_parity) hermetically.
+* fake ``cassandra`` — a session executing the adapter's exact CQL
+  against an in-memory table with the reference's primary-key-upsert
+  semantics; DDL/INSERT/scan shapes pinned against reference
+  attendance_processor.py:56-72,116-124 and attendance_analysis.py:22-39.
+* fake ``pulsar`` — Client/ConsumerType backed by the memory broker;
+  pins the Shared-subscription default (reference
+  attendance_processor.py:30-34) and runs a real FusedPipeline over the
+  adapter end to end.
+"""
+
+import importlib
+import re
+import sys
+import types
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from attendance_tpu.config import Config
+from attendance_tpu.sketch.redis_sim import RedisSimSketchStore
+from attendance_tpu.storage.memory_store import AttendanceRow
+
+
+# ---------------------------------------------------------------------------
+# Fake redis-py
+# ---------------------------------------------------------------------------
+
+class _FakeRedisResponseError(Exception):
+    pass
+
+
+class _FakePipeline:
+    """Records (command, args) like redis-py's pipeline, executing them
+    against the sim server only at execute() — so the adapter's
+    batching/chunking behavior is what's exercised, not bypassed."""
+
+    def __init__(self, server):
+        self._server = server
+        self.commands = []
+
+    def execute_command(self, *args):
+        self.commands.append(args)
+        return self
+
+    def pfadd(self, key, *members):
+        self.commands.append(("PFADD", key, *members))
+        return self
+
+    def execute(self):
+        out = []
+        for args in self.commands:
+            try:
+                out.append(self._server.execute_command(*args))
+            except Exception as e:  # sim facade error -> redis error
+                raise _FakeRedisResponseError(str(e)) from e
+        self.commands = []
+        return out
+
+
+class _FakeRedis:
+    """redis.Redis stand-in; the 'server' is a RedisSimSketchStore
+    shared by every connection to the same (host, port)."""
+
+    servers = {}
+
+    def __init__(self, host="localhost", port=6379, decode_responses=False,
+                 socket_connect_timeout=None, socket_timeout=None):
+        key = (host, int(port))
+        if key not in self.servers:
+            self.servers[key] = RedisSimSketchStore(
+                Config(sketch_backend="redis-sim"))
+        self._server = self.servers[key]
+        self.pipelines = []
+
+    def ping(self):
+        return True
+
+    def execute_command(self, *args):
+        try:
+            return self._server.execute_command(*args)
+        except Exception as e:
+            raise _FakeRedisResponseError(str(e)) from e
+
+    def pfadd(self, key, *members):
+        return self._server.pfadd(str(key), *members)
+
+    def pfcount(self, *keys):
+        return self._server.pfcount(*[str(k) for k in keys])
+
+    def pipeline(self):
+        p = _FakePipeline(self._server)
+        self.pipelines.append(p)
+        return p
+
+    def delete(self, *keys):
+        n = 0
+        for k in keys:
+            n += int(self._server._blooms.pop(str(k), None) is not None)
+            n += int(self._server._hlls.pop(str(k), None) is not None)
+        return n
+
+    def flushall(self):
+        self._server.flush()
+
+    def close(self):
+        pass
+
+
+def _fake_redis_module():
+    mod = types.ModuleType("redis")
+    mod.Redis = _FakeRedis
+    exc = types.ModuleType("redis.exceptions")
+    exc.ResponseError = _FakeRedisResponseError
+    mod.exceptions = exc
+    return mod
+
+
+@pytest.fixture
+def redis_store_cls(monkeypatch):
+    """RedisSketchStore bound to the fake redis module (reloaded so the
+    module-level import gate sees it); restores the pristine module
+    state afterwards."""
+    _FakeRedis.servers = {}
+    monkeypatch.setitem(sys.modules, "redis", _fake_redis_module())
+    import attendance_tpu.sketch.redis_store as rs
+    importlib.reload(rs)
+    assert rs.HAVE_REDIS
+    yield rs.RedisSketchStore
+    monkeypatch.delitem(sys.modules, "redis")
+    importlib.reload(rs)
+
+
+class TestRedisAdapter:
+    def test_full_surface_and_pipeline_chunking(self, redis_store_cls):
+        store = redis_store_cls(Config(sketch_backend="redis"))
+        # Bootstrap shapes (reference attendance_processor.py:74-92).
+        assert store.execute_command("BF.EXISTS", "bf", "test") == 0
+        store.bf_reserve("bf", 0.01, 5000)
+        from attendance_tpu.sketch.base import ResponseError
+        with pytest.raises(ResponseError):  # translated exception type
+            store.bf_reserve("bf", 0.01, 5000)
+        roster = np.arange(10_000, 12_000, dtype=np.uint32)
+        added = store.bf_add_many("bf", roster)
+        assert added.sum() == len(roster)
+        # The adapter chunks BF.MADD at 512 members through ONE pipeline.
+        pipe = store.client.pipelines[-1]
+        assert pipe.commands == []  # drained by execute()
+        exists = store.bf_exists_many("bf", roster)
+        assert exists.all()
+        assert not store.bf_exists_many(
+            "bf", np.arange(500_000, 500_200, dtype=np.uint32)).any()
+        # HLL surface incl. masked bulk adds.
+        assert store.pfadd("h", 1, 2, 3) == 1
+        mask = np.zeros(len(roster), dtype=bool)
+        mask[:100] = True
+        store.pfadd_many("h", roster, mask=mask)
+        c = store.pfcount("h")
+        assert abs(c - 103) <= 3
+        store.flush()
+        assert store.pfcount("h") == 0
+        store.close()
+
+    def test_parity_harness_redis_plumbing(self, redis_store_cls):
+        """check_redis + run_redis_parity end to end against the fake
+        server — every line of the gated parity path executes."""
+        from attendance_tpu.parity import check_redis, run_redis_parity
+
+        config = Config(sketch_backend="redis")
+        check_redis(config)  # ping + BF.RESERVE probe + delete
+        report = run_redis_parity(config, num_events=4000,
+                                  roster_size=1200, num_lectures=2,
+                                  seed=9)
+        assert report.ok, report.summary()
+
+    def test_check_redis_reports_missing_module_cleanly(self, monkeypatch):
+        from attendance_tpu.parity import RedisUnavailable, check_redis
+        monkeypatch.setitem(sys.modules, "redis", None)
+        with pytest.raises(RedisUnavailable):
+            check_redis(Config())
+
+
+# ---------------------------------------------------------------------------
+# Fake cassandra-driver
+# ---------------------------------------------------------------------------
+
+class _FakeResultSet(list):
+    def one(self):
+        return self[0]
+
+
+class _FakePrepared:
+    def __init__(self, cql):
+        self.cql = cql
+
+
+class _FakeFuture:
+    def __init__(self, fn):
+        self._fn = fn
+        self._done = False
+
+    def result(self):
+        if not self._done:
+            self._fn()
+            self._done = True
+
+
+class _Row:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    def __getitem__(self, i):  # COUNT(*) rows are indexed positionally
+        return list(self.__dict__.values())[i]
+
+
+class _FakeSession:
+    """Executes exactly the CQL shapes the adapter issues, with the
+    reference table's primary-key-upsert semantics
+    (PRIMARY KEY ((lecture_id), timestamp, student_id))."""
+
+    def __init__(self):
+        self.keyspaces = set()
+        self.keyspace = None
+        self.tables = set()
+        self.rows = {}  # (lecture_id, ts, student_id) -> is_valid
+        self.ddl = []
+
+    def set_keyspace(self, ks):
+        assert ks in self.keyspaces, f"keyspace {ks} does not exist"
+        self.keyspace = ks
+
+    def prepare(self, cql):
+        assert "INSERT INTO attendance" in cql and cql.count("?") == 4
+        return _FakePrepared(cql)
+
+    def execute_async(self, stmt, params):
+        assert isinstance(stmt, _FakePrepared)
+        student_id, lecture_id, ts, is_valid = params
+        assert isinstance(ts, datetime)
+
+        def apply():
+            self.rows[(lecture_id, ts, int(student_id))] = bool(is_valid)
+        return _FakeFuture(apply)
+
+    def execute(self, query, params=None):
+        q = " ".join(query.split())
+        if q.startswith("CREATE KEYSPACE IF NOT EXISTS"):
+            self.ddl.append(q)
+            self.keyspaces.add(q.split()[5])  # CREATE KEYSPACE IF NOT EXISTS <name>
+            return _FakeResultSet()
+        if q.startswith("CREATE TABLE IF NOT EXISTS attendance"):
+            self.ddl.append(q)
+            assert self.keyspace, "table DDL before set_keyspace"
+            self.tables.add("attendance")
+            return _FakeResultSet()
+        if q == "SELECT DISTINCT lecture_id FROM attendance":
+            return _FakeResultSet(
+                _Row(lecture_id=lec)
+                for lec in {k[0] for k in self.rows})
+        if q.startswith("SELECT student_id, lecture_id, timestamp, "
+                        "is_valid FROM attendance WHERE lecture_id = %s "
+                        "ALLOW FILTERING"):
+            (lec,) = params
+            keys = sorted((k for k in self.rows if k[0] == lec),
+                          key=lambda k: (k[1], k[2]))  # clustering order
+            return _FakeResultSet(
+                _Row(student_id=k[2], lecture_id=k[0], timestamp=k[1],
+                     is_valid=self.rows[k]) for k in keys)
+        if q == "SELECT COUNT(*) FROM attendance":
+            return _FakeResultSet([_Row(count=len(self.rows))])
+        if q == "TRUNCATE attendance":
+            self.rows.clear()
+            return _FakeResultSet()
+        raise AssertionError(f"unexpected CQL: {q!r}")
+
+
+class _FakeCluster:
+    last = None
+
+    def __init__(self, hosts):
+        assert isinstance(hosts, list)
+        self.hosts = hosts
+        self.session = _FakeSession()
+        self.shut = False
+        _FakeCluster.last = self
+
+    def connect(self):
+        return self.session
+
+    def shutdown(self):
+        self.shut = True
+
+
+@pytest.fixture
+def cassandra_store_cls(monkeypatch):
+    mod = types.ModuleType("cassandra")
+    cluster_mod = types.ModuleType("cassandra.cluster")
+    cluster_mod.Cluster = _FakeCluster
+    mod.cluster = cluster_mod
+    monkeypatch.setitem(sys.modules, "cassandra", mod)
+    monkeypatch.setitem(sys.modules, "cassandra.cluster", cluster_mod)
+    import attendance_tpu.storage.cassandra_store as cs
+    importlib.reload(cs)
+    assert cs.HAVE_CASSANDRA
+    yield cs.CassandraEventStore
+    monkeypatch.delitem(sys.modules, "cassandra")
+    monkeypatch.delitem(sys.modules, "cassandra.cluster")
+    importlib.reload(cs)
+
+
+class TestCassandraAdapter:
+    def test_ddl_matches_reference_schema(self, cassandra_store_cls):
+        from attendance_tpu.storage import make_event_store
+        store = make_event_store(Config(storage_backend="cassandra"))
+        session = _FakeCluster.last.session
+        ks_ddl, table_ddl = session.ddl[0], session.ddl[1]
+        # Reference DDL shapes (attendance_processor.py:56-72).
+        assert "SimpleStrategy" in ks_ddl
+        assert "'replication_factor': 1" in ks_ddl
+        assert re.search(r"PRIMARY KEY \(\(lecture_id\), timestamp, "
+                         r"student_id\)", table_ddl)
+        for col in ("student_id int", "lecture_id text",
+                    "timestamp timestamp", "is_valid boolean"):
+            assert col in table_ddl
+        store.close()
+        assert _FakeCluster.last.shut
+
+    def test_insert_scan_upsert_and_truncate(self, cassandra_store_cls):
+        store = cassandra_store_cls(Config(storage_backend="cassandra"))
+
+        def row(sid, lec, ts, valid):
+            return AttendanceRow(student_id=sid, timestamp=ts,
+                                 lecture_id=lec, is_valid=valid,
+                                 event_type="entry")
+
+        n = store.insert_batch([
+            row(11, "L1", "2026-07-01T09:00:00", True),
+            row(12, "L1", "2026-07-01T09:05:00", True),
+            row(13, "L2", "2026-07-01T10:00:00", False),
+        ])
+        assert n == 3
+        # Replaying the same primary key upserts (the reference's
+        # idempotency under at-least-once redelivery,
+        # attendance_processor.py:116-124): same row count, last write
+        # wins on the non-key column.
+        store.insert(row(11, "L1", "2026-07-01T09:00:00", False))
+        assert store.count() == 3
+        assert store.distinct_lecture_ids() == ["L1", "L2"]
+        scan = store.scan_lecture("L1")
+        assert [r.student_id for r in scan] == [11, 12]  # clustering order
+        assert scan[0].is_valid is False  # upserted value
+        assert scan[0].timestamp == "2026-07-01T09:00:00"
+        assert scan[0].event_type == "entry"  # placeholder column
+        assert len(store.scan_all()) == 3
+        # >128 rows exercises the in-flight async INSERT window.
+        store.insert_batch([
+            row(1000 + i, "L3", f"2026-07-02T09:{i % 60:02d}:{i // 60:02d}",
+                True) for i in range(300)])
+        assert store.count() == 303
+        store.truncate()
+        assert store.count() == 0
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# Fake pulsar-client
+# ---------------------------------------------------------------------------
+
+def _fake_pulsar_module():
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    mod = types.ModuleType("pulsar")
+
+    class ConsumerType:
+        Exclusive = "Exclusive"
+        Shared = "Shared"
+        Failover = "Failover"
+
+    class Client:
+        def __init__(self, service_url):
+            self.service_url = service_url
+            self._inner = MemoryClient(MemoryBroker())
+            self.subscribed_types = []
+            self.closed = False
+            Client.last = self
+
+        def create_producer(self, topic):
+            return self._inner.create_producer(topic)
+
+        def subscribe(self, topic, subscription_name, consumer_type=None):
+            self.subscribed_types.append(consumer_type)
+            return self._inner.subscribe(topic, subscription_name)
+
+        def close(self):
+            self.closed = True
+            self._inner.close()
+
+    mod.ConsumerType = ConsumerType
+    mod.Client = Client
+    return mod
+
+
+@pytest.fixture
+def pulsar_client_cls(monkeypatch):
+    monkeypatch.setitem(sys.modules, "pulsar", _fake_pulsar_module())
+    import attendance_tpu.transport.pulsar_client as pc
+    importlib.reload(pc)
+    assert pc.HAVE_PULSAR
+    yield pc.PulsarClient
+    monkeypatch.delitem(sys.modules, "pulsar")
+    importlib.reload(pc)
+
+
+class TestPulsarAdapter:
+    def test_shared_subscription_default_and_forwarding(
+            self, pulsar_client_cls):
+        from attendance_tpu.transport import make_client
+
+        client = make_client(Config(transport_backend="pulsar"))
+        fake = sys.modules["pulsar"].Client.last
+        assert fake.service_url == Config().pulsar_host
+        prod = client.create_producer("t")
+        cons = client.subscribe("t", "sub")
+        # The reference's Shared subscription type is the default
+        # (attendance_processor.py:30-34).
+        assert fake.subscribed_types == ["Shared"]
+        prod.send(b"hello")
+        msg = cons.receive(timeout_millis=100)
+        assert msg.data() == b"hello"
+        cons.negative_acknowledge(msg)  # redelivery
+        msg2 = cons.receive(timeout_millis=2000)
+        assert msg2.data() == b"hello"
+        cons.acknowledge(msg2)
+        assert cons.backlog() == 0
+        client.close()
+        assert fake.closed
+
+    def test_fused_pipeline_runs_over_the_pulsar_adapter(
+            self, pulsar_client_cls):
+        """The flagship pipeline end to end through the adapter: the
+        same consume/validate/count/ack flow the reference runs against
+        a real broker (attendance_processor.py:100-136)."""
+        from attendance_tpu.pipeline.fast_path import FusedPipeline
+        from attendance_tpu.pipeline.loadgen import generate_frames
+
+        config = Config(transport_backend="pulsar",
+                        bloom_filter_capacity=10_000)
+        client = pulsar_client_cls(config.pulsar_host)
+        pipe = FusedPipeline(config, client=client, num_banks=8)
+        roster, frames = generate_frames(4096, 1024, roster_size=5000,
+                                         num_lectures=4, seed=4)
+        pipe.preload(roster)
+        prod = client.create_producer(config.pulsar_topic)
+        for f in frames:
+            prod.send(f)
+        pipe.run(max_events=4096, idle_timeout_s=0.3)
+        assert pipe.metrics.events == 4096
+        assert pipe.consumer.backlog() == 0
+        days = pipe.lecture_days()
+        assert days and all(pipe.count(d) > 0 for d in days)
+        pipe.cleanup()

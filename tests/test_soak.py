@@ -1,12 +1,15 @@
-"""Randomized crash/restart soak (opt-in: ATP_SOAK=1).
+"""Randomized crash/restart soak.
 
 Repeatedly crashes a checkpointed pipeline at random progress points —
 random batch sizes, mesh shapes (single-chip and sharded), capacities,
 wire formats, and snapshot cadences — and asserts the final store +
-PFCOUNTs always
-equal an uninterrupted reference run. Exercises the full
-at-least-once / idempotent-replay / snapshot-barrier story end to end
-(SURVEY.md §5); kept out of the default suite for runtime (~1 min).
+PFCOUNTs always equal an uninterrupted reference run. Exercises the
+full at-least-once / idempotent-replay / snapshot-barrier story end to
+end (SURVEY.md §5).
+
+Two tiers (VERDICT r02 #8): a reduced run (2 cycles, ~20s) is part of
+the DEFAULT suite so the randomized property executes every round; the
+full-length version (6 cycles) stays behind ``ATP_SOAK=1``.
 """
 
 import os
@@ -16,20 +19,16 @@ import tempfile
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.skipif(
-    os.environ.get("ATP_SOAK") != "1",
-    reason="soak test: set ATP_SOAK=1 to run")
 
-
-def test_randomized_crash_restart_soak():
+def _soak(num_cycles: int, seed: int) -> None:
     from attendance_tpu.config import Config
     from attendance_tpu.pipeline.fast_path import FusedPipeline
     from attendance_tpu.pipeline.loadgen import generate_frames
     from attendance_tpu.transport.memory_broker import (
         MemoryBroker, MemoryClient)
 
-    rng = np.random.default_rng(123)
-    for cycle in range(6):
+    rng = np.random.default_rng(seed)
+    for cycle in range(num_cycles):
         B = int(rng.choice([512, 1024, 2048]))
         NF = int(rng.integers(6, 14))
         sharded = bool(rng.random() < 0.5)
@@ -92,3 +91,17 @@ def test_randomized_crash_restart_soak():
                 assert np.array_equal(got_cols[k], ref_cols[k]), (cycle, k)
         finally:
             shutil.rmtree(snapdir, ignore_errors=True)
+
+
+def test_crash_restart_soak_reduced():
+    """Always-on tier: two randomized crash/restart cycles per run."""
+    _soak(num_cycles=2, seed=123)
+
+
+@pytest.mark.skipif(
+    os.environ.get("ATP_SOAK") != "1",
+    reason="full soak: set ATP_SOAK=1 to run")
+def test_randomized_crash_restart_soak():
+    """Full-length tier (6 cycles) — opt-in, different seed stream from
+    the reduced tier so the two don't replay identical populations."""
+    _soak(num_cycles=6, seed=1234)
