@@ -264,6 +264,24 @@ def fused_step_seg(state: SketchState, buf: jax.Array, params: BloomParams,
     at most two uint32 words); the VPU cost is noise next to the Bloom
     gather chain that follows.
     """
+    keys, bank_idx, real = decode_seg_lanes(buf, kb, padded, num_banks)
+    valid = bloom_contains_words(state.bloom_bits, keys, params)
+    regs = hll_add(state.hll_regs,
+                   jnp.where(valid, bank_idx, -1),
+                   keys, precision=precision)
+    nv = jnp.sum((valid & real).astype(jnp.uint32))
+    nr = jnp.sum(real.astype(jnp.uint32))
+    counters = _bump_counts(state.counts, nv, nr - nv)
+    return SketchState(state.bloom_bits, regs, counters), valid
+
+
+def decode_seg_lanes(buf: jax.Array, kb: int, padded: int, num_banks: int
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Device-side decode of the segmented wire's lanes:
+    (keys uint32[padded], bank_idx int32[padded] with -1 padding,
+    real bool[padded]). Shared by the single-chip fused step and the
+    sharded engine's per-device kernels (each mesh device decodes its
+    own dp-slice buffer with the identical math)."""
     counts = buf[:num_banks]
     i = jnp.arange(padded, dtype=jnp.uint32)
     o = i * jnp.uint32(kb)
@@ -283,14 +301,7 @@ def fused_step_seg(state: SketchState, buf: jax.Array, params: BloomParams,
     bank = jnp.searchsorted(ends, lane, side="right").astype(jnp.int32)
     real = lane < total
     bank_idx = jnp.where(real, bank, -1)
-    valid = bloom_contains_words(state.bloom_bits, keys, params)
-    regs = hll_add(state.hll_regs,
-                   jnp.where(valid, bank_idx, -1),
-                   keys, precision=precision)
-    nv = jnp.sum((valid & real).astype(jnp.uint32))
-    nr = jnp.sum(real.astype(jnp.uint32))
-    counters = _bump_counts(state.counts, nv, nr - nv)
-    return SketchState(state.bloom_bits, regs, counters), valid
+    return keys, bank_idx, real
 
 
 def make_jitted_step_seg(params: BloomParams, kb: int, padded: int,
@@ -377,6 +388,23 @@ def fused_step_delta(state: SketchState, buf: jax.Array,
     exact under uint32 wraparound because every true per-segment
     partial sum fits 32 bits even when the global cumsum does not.
     """
+    keys, bank_idx, real = decode_delta_lanes(buf, db, padded, num_banks)
+    valid = bloom_contains_words(state.bloom_bits, keys, params)
+    regs = hll_add(state.hll_regs,
+                   jnp.where(valid, bank_idx, -1),
+                   keys, precision=precision)
+    nv = jnp.sum((valid & real).astype(jnp.uint32))
+    nr = jnp.sum(real.astype(jnp.uint32))
+    counters = _bump_counts(state.counts, nv, nr - nv)
+    return SketchState(state.bloom_bits, regs, counters), valid
+
+
+def decode_delta_lanes(buf: jax.Array, db: int, padded: int,
+                       num_banks: int
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Device-side decode of the delta wire's lanes: (keys, bank_idx
+    with -1 padding, real). Shared by the single-chip fused step and
+    the sharded engine's per-device kernels."""
     counts = buf[:num_banks]
     bases = buf[num_banks:2 * num_banks]
     i = jnp.arange(padded, dtype=jnp.uint32)
@@ -404,14 +432,7 @@ def fused_step_delta(state: SketchState, buf: jax.Array,
                          c[jnp.maximum(starts - 1, 0)])
     keys = bases[bank_c] + (c - c_before)
     bank_idx = jnp.where(real, bank, -1)
-    valid = bloom_contains_words(state.bloom_bits, keys, params)
-    regs = hll_add(state.hll_regs,
-                   jnp.where(valid, bank_idx, -1),
-                   keys, precision=precision)
-    nv = jnp.sum((valid & real).astype(jnp.uint32))
-    nr = jnp.sum(real.astype(jnp.uint32))
-    counters = _bump_counts(state.counts, nv, nr - nv)
-    return SketchState(state.bloom_bits, regs, counters), valid
+    return keys, bank_idx, real
 
 
 def make_jitted_step_delta(params: BloomParams, db: int, padded: int,

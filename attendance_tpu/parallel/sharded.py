@@ -55,6 +55,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from attendance_tpu.models.bloom import (
     BLOCK_BITS, PRELOAD_CHUNK, BloomParams, bloom_positions,
     chunked_preload, derive_bloom_params, packed_or_scatter)
+from attendance_tpu.models.fused import (
+    _bump_counts, decode_delta_lanes, decode_seg_lanes)
 from attendance_tpu.models.hll import (
     estimate_from_histogram, hll_bucket_rank)
 
@@ -125,6 +127,17 @@ class ShardedSketchEngine:
         self.regs = jax.device_put(
             jnp.zeros((self.dp, num_banks, self.m_regs), jnp.uint8),
             regs_sharding)
+        # Device-side (valid, invalid) totals — the single-chip fused
+        # step's two-lane 64-bit counters (models.fused.SketchState),
+        # one private (2, 2) block per dp replica (every sp device of a
+        # replica computes the identical values from the pmin'd validity
+        # vector, so the block is replicated over "sp"); totals are the
+        # sum over replicas at read time. Closes the r02 gap: the mesh
+        # surfaced no validity totals at all
+        # (observability contract: reference attendance_processor.py:131).
+        self.counts = jax.device_put(
+            np.zeros((self.dp, 2, 2), np.uint32),
+            NamedSharding(mesh, P("dp")))
         self._build_kernels()
 
     # -- shard_map kernels --------------------------------------------------
@@ -189,7 +202,16 @@ class ShardedSketchEngine:
                 out = jax.lax.pmax(out, "dp")
             return out
 
-        def step_kernel(bits_loc, regs_loc, keys, bank_idx, mask):
+        def bump_local(counts_loc, valid, real):
+            """Accumulate (valid, invalid) real-lane totals into this
+            replica's private (1, 2, 2) counter block — the single-chip
+            two-lane 64-bit counter design, per dp replica."""
+            nv = jnp.sum((valid & real).astype(jnp.uint32))
+            nr = jnp.sum(real.astype(jnp.uint32))
+            return _bump_counts(counts_loc[0], nv, nr - nv)[None]
+
+        def step_kernel(bits_loc, regs_loc, counts_loc, keys, bank_idx,
+                        mask):
             """Fused hot-loop step on one device: validate the local batch
             slice against the sharded Bloom, then count the valid events
             into the sharded HLL banks."""
@@ -198,7 +220,9 @@ class ShardedSketchEngine:
             valid = jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
             new_regs = hll_add_local(
                 regs_loc, jnp.where(valid, bank_idx, -1), keys, mask)
-            return valid, new_regs
+            return valid, new_regs, bump_local(counts_loc, valid, mask)
+
+        counts_spec = P("dp")
 
         def make_step_words(kw: int):
             """step_kernel over the packed word wire (see
@@ -210,7 +234,7 @@ class ShardedSketchEngine:
             key_mask = jnp.uint32((1 << kw) - 1)
             sentinel = jnp.uint32((1 << (32 - kw)) - 1)
 
-            def step_words_kernel(bits_loc, regs_loc, words):
+            def step_words_kernel(bits_loc, regs_loc, counts_loc, words):
                 keys = words & key_mask
                 banks_u = words >> kw
                 bank_idx = jnp.where(banks_u == sentinel, jnp.int32(-1),
@@ -220,15 +244,52 @@ class ShardedSketchEngine:
                 valid = jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
                 new_regs = hll_add_local(
                     regs_loc, jnp.where(valid, bank_idx, -1), keys, mask)
-                return valid, new_regs
+                return (valid, new_regs,
+                        bump_local(counts_loc, valid, mask))
 
             return jax.jit(jax.shard_map(
                 step_words_kernel, mesh=mesh,
-                in_specs=(P("sp"), P("dp", None, "sp"), P("dp")),
-                out_specs=(P("dp"), P("dp", None, "sp"))),
-                donate_argnums=(1,))
+                in_specs=(P("sp"), P("dp", None, "sp"), counts_spec,
+                          P("dp")),
+                out_specs=(P("dp"), P("dp", None, "sp"), counts_spec),
+                check_vma=False),
+                donate_argnums=(1, 2))
 
         self._make_step_words = make_step_words
+
+        def make_step_narrow(mode: str, width: int, padded_local: int,
+                             nbanks: int):
+            """step_kernel over the seg/delta bit-packed wires — the
+            same host-link economy the single-chip wire ladder gets
+            (kb/db bits per event instead of 32). Each dp replica ships
+            its OWN packed buffer (the batch is range-split on the host,
+            each slice packed independently at ``padded_local`` lanes);
+            each device decodes its slice with the single-chip decode
+            math (models.fused.decode_*_lanes) and the validity AND
+            rides "sp" exactly like the other wires."""
+            decode = (decode_seg_lanes if mode == "seg"
+                      else decode_delta_lanes)
+
+            def step_narrow_kernel(bits_loc, regs_loc, counts_loc,
+                                   buf_loc):
+                keys, bank_idx, real = decode(buf_loc[0], width,
+                                              padded_local, nbanks)
+                partial = local_contains(bits_loc, keys)
+                valid = jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
+                new_regs = hll_add_local(
+                    regs_loc, jnp.where(valid, bank_idx, -1), keys, real)
+                return (valid, new_regs,
+                        bump_local(counts_loc, valid, real))
+
+            return jax.jit(jax.shard_map(
+                step_narrow_kernel, mesh=mesh,
+                in_specs=(P("sp"), P("dp", None, "sp"), counts_spec,
+                          P("dp", None)),
+                out_specs=(P("dp"), P("dp", None, "sp"), counts_spec),
+                check_vma=False),
+                donate_argnums=(1, 2))
+
+        self._make_step_narrow = make_step_narrow
 
         def query_kernel(bits_loc, keys):
             partial = local_contains(bits_loc, keys)
@@ -273,9 +334,15 @@ class ShardedSketchEngine:
         regs_spec = P("dp", None, "sp")
         self._step = jax.jit(smap(
             step_kernel,
-            in_specs=(P("sp"), regs_spec, P("dp"), P("dp"), P("dp")),
-            out_specs=(P("dp"), regs_spec)),
-            donate_argnums=(1,))
+            in_specs=(P("sp"), regs_spec, counts_spec, P("dp"), P("dp"),
+                      P("dp")),
+            out_specs=(P("dp"), regs_spec, counts_spec),
+            check_vma=False),
+            donate_argnums=(1, 2))
+        # Replicates the per-replica counter blocks so they are host-
+        # readable on a multi-host mesh (dp spans processes there).
+        self._read_counts = jax.jit(
+            lambda c: c, out_shardings=NamedSharding(mesh, P(None)))
         # check_vma=False: like the preload's all_gather+OR, the static
         # varying-axes checker cannot infer that pmin + tiled all_gather
         # leave every device with the identical vector.
@@ -329,8 +396,31 @@ class ShardedSketchEngine:
         step = self._word_step_cache.get(kw)
         if step is None:
             step = self._word_step_cache[kw] = self._make_step_words(kw)
-        valid, self.regs = step(self.bits, self.regs, jnp.asarray(words))
+        valid, self.regs, self.counts = step(
+            self.bits, self.regs, self.counts, jnp.asarray(words))
         return valid[:n]
+
+    def step_narrow(self, bufs: np.ndarray, mode: str, width: int,
+                    padded_local: int) -> jax.Array:
+        """Fused validate+count over the seg/delta wires: ``bufs`` is
+        uint32[dp, buf_words] — one independently-packed buffer per dp
+        replica, each covering ``padded_local`` lanes of its contiguous
+        batch-range slice. Returns the full validity vector in PACKED
+        per-slice order (length dp * padded_local); the caller holds the
+        pack permutations. One compiled program per
+        (mode, width, buf width), cached."""
+        # The kernel bakes in every geometry input — the lane count and
+        # the bank header width, not just the resulting buffer length
+        # (distinct (padded_local, num_banks) pairs can collide on
+        # buffer words and must not share a compiled program).
+        key = (mode, width, padded_local, self.num_banks)
+        step = self._word_step_cache.get(key)
+        if step is None:
+            step = self._word_step_cache[key] = self._make_step_narrow(
+                mode, width, padded_local, self.num_banks)
+        valid, self.regs, self.counts = step(
+            self.bits, self.regs, self.counts, bufs)
+        return valid
 
     def step(self, keys, bank_idx) -> jax.Array:
         """Fused validate+count for one micro-batch; returns validity[B].
@@ -345,10 +435,39 @@ class ShardedSketchEngine:
         bbuf, _ = self._pad(bank_idx, -1, np.int32)
         mask = np.zeros(len(kbuf), dtype=bool)
         mask[:n] = True
-        valid, self.regs = self._step(self.bits, self.regs,
-                                      jnp.asarray(kbuf), jnp.asarray(bbuf),
-                                      jnp.asarray(mask))
+        valid, self.regs, self.counts = self._step(
+            self.bits, self.regs, self.counts,
+            jnp.asarray(kbuf), jnp.asarray(bbuf), jnp.asarray(mask))
         return valid[:n]
+
+    # -- device-side validity counters ---------------------------------------
+    def validity_counts(self) -> Tuple[int, int]:
+        """(valid, invalid) totals accumulated on device since
+        construction (or the last set_counts): per-replica two-lane
+        64-bit counters, decoded and summed host-side. Forces a device
+        sync + D2H read — call after the last run (platform caveat in
+        pipeline.fast_path.validity_counts)."""
+        a = np.asarray(self._read_counts(self.counts)).astype(np.uint64)
+        lo, hi = a[:, :, 0], a[:, :, 1]
+        totals = (lo + (hi << np.uint64(32))).sum(axis=0)
+        return int(totals[0]), int(totals[1])
+
+    def get_counts(self) -> np.ndarray:
+        """Counter totals in the single-chip snapshot encoding:
+        uint32[2, 2] two-lane rows (valid, invalid) — what snapshots
+        store, restorable on any mesh shape or the single-chip path."""
+        v, i = self.validity_counts()
+        return np.array([[v & 0xFFFFFFFF, v >> 32],
+                         [i & 0xFFFFFFFF, i >> 32]], dtype=np.uint32)
+
+    def set_counts(self, counts) -> None:
+        """Install snapshot counter totals: replica 0 carries them, the
+        others restart at zero — totals are a sum over replicas, so
+        this is exact on any mesh shape."""
+        tiled = np.zeros((self.dp, 2, 2), np.uint32)
+        tiled[0] = np.asarray(counts, dtype=np.uint32).reshape(2, 2)
+        self.counts = jax.device_put(
+            tiled, NamedSharding(self.mesh, P("dp")))
 
     def contains(self, keys) -> np.ndarray:
         keys = np.asarray(keys, dtype=np.uint32)

@@ -84,25 +84,33 @@ EVENTS_SNAPSHOT = "fused_events.npz"
 
 
 class _ScatterValidity:
-    """Lazy original-order view of the seg wire's permuted validity.
+    """Lazy original-order view of the seg/delta wires' permuted
+    validity.
 
     Holds the (possibly still in-flight) device vector plus the packed
     lane -> original index permutation; materializes ``out[perm] = v``
     only when a reader asks (store compaction, snapshot) — the hot loop
     never pays the scatter, and the device sync stays as lazy as the
     raw jax array the store keeps for the other wires.
+
+    Single-chip packs put all n real lanes first (``lanes=None``); the
+    sharded engine's per-replica packs leave the real lanes at each
+    slice's front, so the caller passes their explicit ``lanes``
+    positions (len n, aligned with ``perm``).
     """
 
-    __slots__ = ("_valid", "_perm", "_n")
+    __slots__ = ("_valid", "_perm", "_n", "_lanes")
 
-    def __init__(self, valid, perm, n: int):
+    def __init__(self, valid, perm, n: int, lanes=None):
         self._valid, self._perm, self._n = valid, perm, n
+        self._lanes = lanes
 
     def __len__(self) -> int:
         return self._n
 
     def __array__(self, dtype=None, copy=None):
-        v = np.asarray(self._valid)[:self._n]
+        v = np.asarray(self._valid)
+        v = v[:self._n] if self._lanes is None else v[self._lanes]
         out = np.empty(self._n, v.dtype)
         out[self._perm] = v
         if dtype is not None and np.dtype(dtype) != out.dtype:
@@ -124,11 +132,11 @@ class FusedPipeline:
         self.sharded = (self.config.num_shards
                         * self.config.num_replicas) > 1
         if self.sharded:
-            if self.config.wire_format != "auto":
+            if self.config.wire_format == "bytes":
                 logger.warning(
-                    "--wire-format=%s has no effect with num_shards/"
-                    "num_replicas > 1: the sharded engine uses its own "
-                    "mesh transfer layout", self.config.wire_format)
+                    "--wire-format=bytes has no effect with num_shards/"
+                    "num_replicas > 1: the sharded engine carries wide "
+                    "frames as separate key/bank arrays instead")
             from attendance_tpu.parallel.multihost import (
                 init_distributed, make_multihost_mesh)
             from attendance_tpu.parallel.sharded import ShardedSketchEngine
@@ -146,8 +154,13 @@ class FusedPipeline:
                 replica_sync=self.config.replica_sync)
             self.params = self.engine.params
             # Monotonic key-width hint for the mesh word wire (same
-            # compile-churn bound as the single-chip _pick_kw path).
+            # compile-churn bound as the single-chip _pick_kw path),
+            # plus the delta-width hint/decay state the mesh seg/delta
+            # wires share with the single-chip ladder.
             self._kw_hint = 1
+            self._db_hint = 1
+            self._db_slack = 0
+            self._db_seen = 1
         else:
             self.engine = None
             self.state, self.params = init_state(
@@ -347,21 +360,31 @@ class FusedPipeline:
             sid = cols["student_id"]
             banks = self._banks_for(cols["lecture_day"])
             num_banks = self.engine.num_banks
-            kw = self._pick_kw(int(sid.max()).bit_length(), num_banks)
-            with maybe_annotate(self._profiling, "sharded_fused_step"):
-                if kw + num_banks.bit_length() <= 32:
-                    # Packed word wire onto the mesh: 4 B/event per
-                    # chip instead of the 9 of keys + bank ids + mask.
-                    self._kw_hint = kw
-                    self._count_wire("word")
-                    words = pack_words(sid, banks, kw,
-                                       self.engine.padded_size(n))
-                    valid_n = self.engine.step_words(words, n, kw)
-                else:
-                    # Separate key/bank/mask arrays (9 B/event).
-                    self._count_wire("arrays")
-                    valid_n = self.engine.step(sid, banks)
-            stored = valid_n
+            if self.config.wire_format in ("seg", "delta"):
+                with maybe_annotate(self._profiling,
+                                    "sharded_narrow_step"):
+                    valid_n, lanes, orig = self._dispatch_sharded_narrow(
+                        sid, banks, n, self.config.wire_format)
+                # valid_n is in packed per-slice order; the lazy view
+                # restores original order at read time (same contract
+                # as the single-chip narrow wires below).
+                stored = _ScatterValidity(valid_n, orig, n, lanes=lanes)
+            else:
+                kw = self._pick_kw(int(sid.max()).bit_length(), num_banks)
+                with maybe_annotate(self._profiling, "sharded_fused_step"):
+                    if kw + num_banks.bit_length() <= 32:
+                        # Packed word wire onto the mesh: 4 B/event per
+                        # chip instead of the 9 of keys + bank ids + mask.
+                        self._kw_hint = kw
+                        self._count_wire("word")
+                        words = pack_words(sid, banks, kw,
+                                           self.engine.padded_size(n))
+                        valid_n = self.engine.step_words(words, n, kw)
+                    else:
+                        # Separate key/bank/mask arrays (9 B/event).
+                        self._count_wire("arrays")
+                        valid_n = self.engine.step(sid, banks)
+                stored = valid_n
         else:
             padded = 256
             while padded < n:
@@ -601,6 +624,58 @@ class FusedPipeline:
         self.state, valid = self._step(self.state, jax.numpy.asarray(buf))
         return valid, None
 
+    def _dispatch_sharded_narrow(self, sid: np.ndarray, banks: np.ndarray,
+                                 n: int, mode: str):
+        """Seg/delta wires over the mesh: split the batch into dp
+        contiguous range slices, pack each independently at the
+        engine's per-replica lane count, and ship ONE uint32[dp, words]
+        array whose leading axis is dp-sharded — each replica's chip
+        receives only its own packed buffer, the same bits-per-event
+        link economy the single-chip ladder gets. Returns
+        (valid, lanes, orig): ``valid`` is the device vector in packed
+        per-slice order; ``lanes``/``orig`` map its real lanes back to
+        original event order for the lazy store view."""
+        engine = self.engine
+        dp = engine.dp
+        num_banks = engine.num_banks
+        padded_local = engine.padded_size(n) // dp
+        bounds = [min(n, r * padded_local) for r in range(dp + 1)]
+        slices = [(sid[bounds[r]:bounds[r + 1]],
+                   banks[bounds[r]:bounds[r + 1]]) for r in range(dp)]
+        if mode == "seg":
+            width = min(max(int(sid.max()).bit_length(), 1,
+                            self._kw_hint), 32)
+            self._kw_hint = width
+            scans = None
+        else:
+            # One shared delta width across replicas (the compiled step
+            # is per-width); each slice's scan is reused by its pack.
+            scans = [delta_scan(ks, bs, num_banks) for ks, bs in slices]
+            needed = max(s[-1] for s in scans)
+            width = pick_delta_width(self._db_hint, needed)
+            self._db_hint = self._decayed_db(width, needed)
+        bufs = None
+        lanes = np.empty(n, np.int64)
+        orig = np.empty(n, np.int64)
+        pos = 0
+        for r, (ks, bs) in enumerate(slices):
+            if mode == "seg":
+                buf, perm = pack_seg(ks, bs, width, padded_local,
+                                     num_banks)
+            else:
+                buf, perm = pack_delta(ks, bs, width, padded_local,
+                                       num_banks, scan=scans[r])
+            if bufs is None:
+                bufs = np.empty((dp, len(buf)), np.uint32)
+            bufs[r] = buf
+            m = len(ks)
+            lanes[pos:pos + m] = r * padded_local + np.arange(m)
+            orig[pos:pos + m] = bounds[r] + perm
+            pos += m
+        self._count_wire(mode)
+        valid = engine.step_narrow(bufs, mode, width, padded_local)
+        return valid, lanes, orig
+
     def _note_word_degrade(self) -> None:
         """Log ONCE when ``--wire-format=word`` was requested but a
         frame's key + bank bits exceed 32 and it must ride the bytes
@@ -772,7 +847,7 @@ class FusedPipeline:
         self._snap_dir.mkdir(parents=True, exist_ok=True)
         if self.sharded:
             bits, regs = self.engine.get_state()
-            counts = np.zeros((2, 2), np.uint32)
+            counts = self.engine.get_counts()
         else:
             bits = np.asarray(self.state.bloom_bits)
             regs = np.asarray(self.state.hll_regs)
@@ -836,6 +911,7 @@ class FusedPipeline:
                         "registers are from different snapshots")
         if self.sharded:
             self.engine.set_state(bits, regs)
+            self.engine.set_counts(counts)
         else:
             self.state = self.state._replace(
                 bloom_bits=jax.numpy.asarray(bits),
@@ -979,14 +1055,15 @@ class FusedPipeline:
 
     def validity_counts(self) -> Optional[tuple]:
         """(valid, invalid) totals accumulated on device since
-        construction; None on the sharded engine (no accumulators).
+        construction (single-chip and sharded — the mesh keeps
+        per-replica two-lane counters summed at read).
 
         Forces a device sync AND (platform caveat) a D2H read that can
         permanently degrade async dispatch on relay-tunneled devices —
         call it after the LAST run of the process, never mid-stream.
         """
         if self.sharded:
-            return None
+            return self.engine.validity_counts()
         from attendance_tpu.models.fused import decode_counts
         return decode_counts(self.state.counts)
 

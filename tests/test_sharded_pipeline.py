@@ -125,3 +125,87 @@ def test_ten_million_roster_sharded():
     outsiders = rng.integers(1 << 28, 1 << 29, 100_000).astype(np.uint32)
     fpr = engine.contains(outsiders).mean()
     assert fpr <= 0.013, fpr
+
+
+@pytest.mark.parametrize("wire", ["seg", "delta"])
+def test_sharded_narrow_wires_match_word_wire(wire):
+    """VERDICT r02 #5: the seg/delta bit-packed wires over the mesh.
+    Forced narrow wires must land on the identical store content and
+    counts as the default word wire, carry their dwell attribution, and
+    keep the device-side validity counters exact."""
+    num_events, batch = 8_192, 2_048
+    roster, frames = generate_frames(num_events, batch, roster_size=5_000,
+                                     num_lectures=6, seed=29)
+    frames = list(frames)
+
+    results = []
+    for wf in ("auto", wire):
+        config = Config(bloom_filter_capacity=20_000,
+                        transport_backend="memory",
+                        num_shards=2, num_replicas=2, wire_format=wf)
+        client = MemoryClient(MemoryBroker())
+        pipe = FusedPipeline(config, client=client, num_banks=8)
+        pipe.preload(roster)
+        producer = client.create_producer(config.pulsar_topic)
+        for f in frames:
+            producer.send(f)
+        pipe.run(max_events=num_events, idle_timeout_s=0.5)
+        assert pipe.consumer.backlog() == 0
+        df = pipe.store.to_dataframe(deduplicate=False).sort_values(
+            ["micros", "student_id"])
+        counts = {d: pipe.count(d) for d in pipe.lecture_days()}
+        results.append((df, counts, pipe.metrics.wire_dwell,
+                        pipe.validity_counts()))
+
+    (df_w, counts_w, _, vc_w), (df_n, counts_n, dwell_n, vc_n) = results
+    np.testing.assert_array_equal(df_w.is_valid.to_numpy(bool),
+                                  df_n.is_valid.to_numpy(bool))
+    np.testing.assert_array_equal(df_w.student_id.to_numpy(np.uint32),
+                                  df_n.student_id.to_numpy(np.uint32))
+    assert counts_w == counts_n
+    assert set(dwell_n) == {wire}  # every frame rode the forced wire
+    # Device-side counters (valid, invalid) agree across wires and sum
+    # to the event count — the r02 gap was validity_counts() is None
+    # when sharded.
+    assert vc_w is not None and vc_n is not None
+    assert vc_w == vc_n
+    assert sum(vc_n) == num_events
+
+
+def test_sharded_validity_counts_and_snapshot_counts(tmp_path):
+    """Counters survive sharded snapshots and restore across mesh
+    shapes (including to/from single-chip), no longer zeroed."""
+    num_events, batch = 4_096, 1_024
+    roster, frames = generate_frames(num_events, batch, roster_size=4_000,
+                                     num_lectures=4, seed=31)
+    config = Config(bloom_filter_capacity=10_000,
+                    transport_backend="memory",
+                    num_shards=2, num_replicas=2,
+                    snapshot_dir=str(tmp_path), snapshot_every_batches=2)
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=num_events, idle_timeout_s=0.5)
+    vc = pipe.validity_counts()
+    assert vc is not None and sum(vc) == num_events
+    pipe.snapshot()
+
+    # Restore onto a DIFFERENT mesh shape: counters carry over.
+    cfg2 = Config(bloom_filter_capacity=10_000,
+                  transport_backend="memory",
+                  num_shards=4, num_replicas=1,
+                  snapshot_dir=str(tmp_path))
+    pipe2 = FusedPipeline(cfg2, client=MemoryClient(MemoryBroker()),
+                          num_banks=8)
+    assert pipe2.validity_counts() == vc
+
+    # And onto the single-chip engine.
+    cfg3 = Config(bloom_filter_capacity=10_000,
+                  transport_backend="memory",
+                  snapshot_dir=str(tmp_path))
+    pipe3 = FusedPipeline(cfg3, client=MemoryClient(MemoryBroker()),
+                          num_banks=8)
+    assert pipe3.validity_counts() == vc
