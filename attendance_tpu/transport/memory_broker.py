@@ -90,12 +90,15 @@ class _Subscription:
                     raise ReceiveTimeout(
                         f"no message within {timeout_s}s on {self.name!r}")
                 self.cond.wait(remaining)
-            out = []
-            while self.pending and len(out) < max_n:
-                mid, data, redeliveries = self.pending.popleft()
-                self.inflight[mid] = (data, redeliveries, owner)
-                out.append(Message(data, mid, redeliveries))
-            return out
+            # Bulk-pop then two comprehensions: at JSON-wire rates this
+            # loop IS the receive cost (hundreds of thousands of
+            # per-message iterations/s), and comprehension + dict.update
+            # run ~2x the interpreted append-per-message form.
+            k = min(max_n, len(self.pending))
+            popped = [self.pending.popleft() for _ in range(k)]
+            self.inflight.update(
+                (mid, (data, red, owner)) for mid, data, red in popped)
+            return [Message(data, mid, red) for mid, data, red in popped]
 
     def acknowledge(self, message_id: int) -> None:
         with self.cond:
