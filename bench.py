@@ -394,6 +394,83 @@ def bench_json(seconds: float, capacity: int, num_banks: int,
     }
 
 
+def _probe_link_rate(seconds: float = 2.0) -> float:
+    """Measured host->device transfer rate (bytes/sec) over ~64MB
+    buffers — the resource the wire ladder trades against host pack
+    cost. Varies multi-x with tunnel weather; recording it next to the
+    per-wire rates makes each wires-mode artifact interpretable."""
+    buf = np.random.default_rng(0).integers(
+        0, 1 << 31, size=1 << 24, dtype=np.uint32)  # 64 MiB
+    dev = jax.device_put(buf)
+    dev.block_until_ready()  # warm the path
+    total = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        jax.device_put(buf).block_until_ready()
+        total += buf.nbytes
+    return total / (time.perf_counter() - t0)
+
+
+def bench_wires(seconds: float, capacity: int, num_banks: int,
+                frame_size: int = 1 << 19) -> dict:
+    """Interleaved forced-wire comparison (VERDICT r02 #3): ONE
+    process, ONE pipeline, same backlog; the forced wire cycles
+    word -> seg -> delta each round so tunnel weather hits all three
+    equally (a sequential per-wire comparison is meaningless here —
+    the link rate swings multi-x between runs). Reports per-wire
+    median e2e rates plus the measured raw link rate, which together
+    say which regime the ladder SHOULD pick right now."""
+    import dataclasses as _dc  # noqa: F401  (parity with sibling benches)
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(bloom_filter_capacity=capacity,
+                    transport_backend="memory", wire_format="word")
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=num_banks)
+    num_frames = max(8, int(seconds * 25e6 / frame_size))
+    num_events = num_frames * frame_size
+    roster, frames = generate_frames(num_events, frame_size,
+                                     roster_size=min(capacity, 1_000_000),
+                                     num_lectures=num_banks)
+    frames = list(frames)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+
+    wires = ["word", "seg", "delta"]
+    for w in wires:  # compile each wire's step once
+        pipe.config.wire_format = w
+        producer.send(frames[0])
+        pipe.run(max_events=frame_size, idle_timeout_s=0.2)
+
+    rates = {w: [] for w in wires}
+    for _round in range(3):
+        for w in wires:
+            pipe.config.wire_format = w
+            for f in frames:
+                producer.send(f)
+            pipe.metrics.events = 0
+            pipe.metrics.wall_seconds = 0.0
+            pipe.run(max_events=num_events, idle_timeout_s=5.0)
+            if pipe.metrics.wall_seconds:
+                rates[w].append(
+                    pipe.metrics.events / pipe.metrics.wall_seconds)
+            pipe.store.truncate()
+    return {
+        "per_wire_events_per_sec": {
+            w: round(float(np.median(v)), 1) for w, v in rates.items()},
+        "per_wire_all": {w: [round(x / 1e6, 2) for x in v]
+                         for w, v in rates.items()},
+        "link_bytes_per_sec": round(_probe_link_rate(), 1),
+        "events_per_frame": frame_size,
+        "device": str(jax.devices()[0]),
+    }
+
+
 def _vs_baseline(events_per_sec: float) -> float:
     n_chips = max(1, len(jax.devices()))
     # Compare against this run's fair share of the 8-chip north star.
@@ -405,13 +482,14 @@ def _vs_baseline(events_per_sec: float) -> float:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="both",
-                    choices=["both", "kernel", "e2e", "json", "bloom",
-                             "hll"],
+                    choices=["both", "kernel", "e2e", "json", "wires",
+                             "bloom", "hll"],
                     help="both/kernel/e2e are the headline benches; "
                     "json times the reference-wire JSON ingress "
-                    "(bridge -> fused pipe); bloom and hll time the "
-                    "standalone sketch kernels (BASELINE.md configs "
-                    "#2 and #3)")
+                    "(bridge -> fused pipe); wires compares the forced "
+                    "wire formats interleaved + the raw link rate; "
+                    "bloom and hll time the standalone sketch kernels "
+                    "(BASELINE.md configs #2 and #3)")
     ap.add_argument("--batch-size", type=int, default=1 << 20,
                     help="kernel-mode device batch size")
     ap.add_argument("--e2e-batch-size", type=int, default=None,
@@ -476,6 +554,20 @@ def main() -> None:
                 "unit": "events/sec",
                 "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
                 "wire": r["wire"],
+            }
+        elif args.mode == "wires":
+            r = bench_wires(args.seconds, args.capacity, args.num_banks)
+            best = max(r["per_wire_events_per_sec"],
+                       key=r["per_wire_events_per_sec"].get)
+            line = {
+                "metric": "wire_comparison_best",
+                "value": r["per_wire_events_per_sec"][best],
+                "unit": "events/sec",
+                "vs_baseline": round(_vs_baseline(
+                    r["per_wire_events_per_sec"][best]), 4),
+                "best_wire": best,
+                "per_wire_events_per_sec": r["per_wire_events_per_sec"],
+                "link_bytes_per_sec": r["link_bytes_per_sec"],
             }
         elif args.mode == "json":
             r = bench_json(args.seconds, args.capacity, args.num_banks)

@@ -73,3 +73,32 @@ def test_hist_kernel_matches_xla(num_banks):
     got = np.asarray(hll_histogram_pallas(regs))
     np.testing.assert_array_equal(ref, got)
     assert got.sum(axis=1).tolist() == [16384] * num_banks
+
+
+@pytest.mark.parametrize("capacity", [2_000, 100_000])
+def test_bloom_hbm_kernel_matches_xla(capacity):
+    """The HBM-resident per-key-DMA probe (VERDICT r02 #7) answers
+    bit-identically to the XLA byte path — including on filters larger
+    than the VMEM kernel's tiled-gather budget."""
+    from attendance_tpu.ops.pallas_kernels import (
+        _HBM_TILE, bloom_contains_hbm, pack_bits_rows)
+
+    params = derive_bloom_params(capacity, 0.01, "blocked")
+    rng = np.random.default_rng(capacity)
+    roster = rng.choice(1 << 20, capacity // 2, replace=False
+                        ).astype(np.uint32)
+    bits = bloom_add(bloom_init(params), jnp.asarray(roster), params)
+    table = pack_bits_rows(bits)
+    # Members and non-members INTERLEAVED across every kernel tile, so
+    # a grid-offset bug in the scalar-prefetch indexing (wrong block
+    # fetched for tiles past the first) shows as false negatives.
+    keys_np = np.where(
+        rng.random(4 * _HBM_TILE) < 0.5,
+        rng.choice(np.asarray(roster), 4 * _HBM_TILE),
+        rng.integers(1 << 20, 1 << 31, 4 * _HBM_TILE).astype(np.uint32))
+    member = np.isin(keys_np, np.asarray(roster))
+    keys = jnp.asarray(keys_np)
+    ref = np.asarray(bloom_contains(bits, keys, params))
+    got = np.asarray(bloom_contains_hbm(table, keys, params))
+    np.testing.assert_array_equal(ref, got)
+    assert got[member].all()
