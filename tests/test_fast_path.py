@@ -197,3 +197,80 @@ def test_pick_kw_drops_stale_hint():
     # frame itself too wide for words: width reported as-is, caller
     # falls back to the byte wire
     assert pipe._pick_kw(30, 512) == 30
+
+
+def test_competing_fused_pipelines_merge_to_one_answer():
+    """The reference's scale-out is competing consumers on one Shared
+    subscription against ONE shared Redis (attendance_processor.py:30-34);
+    here each consumer owns private HBM sketches, so the union is an
+    explicit register-max merge (models.hll.hll_merge) — commutative and
+    idempotent, the same collective the mesh uses. Two pipelines split
+    one topic's frames; their merged per-day counts and summed validity
+    counters must equal a single-consumer run of the same stream."""
+    from attendance_tpu.models.hll import hll_merge
+    from attendance_tpu.models.hll import (
+        best_histogram, estimate_from_histogram)
+
+    num_events, batch = 16_384, 2_048
+    roster, frames = generate_frames(num_events, batch, roster_size=6_000,
+                                     num_lectures=5, seed=41)
+    frames = list(frames)
+
+    def run_single():
+        config = Config(bloom_filter_capacity=20_000,
+                        transport_backend="memory")
+        client = MemoryClient(MemoryBroker())
+        pipe = FusedPipeline(config, client=client, num_banks=8)
+        pipe.preload(roster)
+        prod = client.create_producer(config.pulsar_topic)
+        for f in frames:
+            prod.send(f)
+        pipe.run(max_events=num_events, idle_timeout_s=0.4)
+        return pipe
+
+    ref = run_single()
+    ref_counts = {d: ref.count(d) for d in ref.lecture_days()}
+    ref_vc = ref.validity_counts()
+
+    # Two competing consumers on ONE shared subscription of one broker.
+    config = Config(bloom_filter_capacity=20_000,
+                    transport_backend="memory")
+    broker = MemoryBroker()
+    pipes = [FusedPipeline(config, client=MemoryClient(broker),
+                           num_banks=8) for _ in range(2)]
+    for p in pipes:
+        p.preload(roster)
+    prod = MemoryClient(broker).create_producer(config.pulsar_topic)
+    for f in frames:
+        prod.send(f)
+    # Alternate consumers so both actually take frames from the shared
+    # subscription (single-threaded; each drains a slice of the backlog).
+    took = 0
+    while took < num_events:
+        for p in pipes:
+            before = p.metrics.events
+            p.run(max_events=before + batch, idle_timeout_s=0.2)
+            took += p.metrics.events - before
+    assert pipes[0].consumer.backlog() == 0
+    assert pipes[0].metrics.events > 0 and pipes[1].metrics.events > 0
+    assert (pipes[0].metrics.events + pipes[1].metrics.events
+            == num_events)
+
+    # Merged validity counters match the single-consumer run.
+    vcs = [p.validity_counts() for p in pipes]
+    assert (vcs[0][0] + vcs[1][0], vcs[0][1] + vcs[1][1]) == ref_vc
+
+    # Per-day uniques via explicit register-max union across consumers.
+    days = sorted(set(pipes[0].lecture_days())
+                  | set(pipes[1].lecture_days()))
+    assert days == sorted(ref_counts)
+    for day in days:
+        rows = []
+        for p in pipes:
+            bank = p._bank_of.get(day)
+            if bank is not None:
+                rows.append(p.state.hll_regs[bank])
+        merged = rows[0] if len(rows) == 1 else hll_merge(*rows)
+        hist = np.asarray(best_histogram(merged[None, :], 14))[0]
+        est = int(round(estimate_from_histogram(hist, 14)))
+        assert est == ref_counts[day], (day, est, ref_counts[day])
