@@ -1,0 +1,102 @@
+"""Smoke coverage for the benchmark rig (bench.py).
+
+bench.py is the driver's per-round artifact: if any mode crashes, the
+round records nothing. These tests run every bench function at toy
+sizes on the hermetic CPU backend — they assert structure and sanity,
+never performance (CPU numbers are meaningless; the real numbers come
+from the driver's solo run on the chip).
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+import bench  # noqa: E402
+
+
+def test_fused_step_smoke():
+    r = bench.bench_fused_step(batch_size=2048, seconds=0.2,
+                               capacity=10_000, num_banks=8,
+                               layout="blocked")
+    assert r["events_per_sec"] > 0
+    assert r["steps"] >= 1
+
+
+def test_bloom_smoke():
+    r = bench.bench_bloom(batch_size=2048, seconds=0.2,
+                          capacity=10_000, layout="blocked")
+    assert r["events_per_sec"] > 0
+    assert r["insert_keys_per_sec"] > 0
+
+
+def test_hll_smoke():
+    r = bench.bench_hll(batch_size=2048, seconds=0.2, num_banks=8)
+    assert r["events_per_sec"] > 0
+    assert r["num_banks"] == 8
+
+
+def test_e2e_smoke():
+    r = bench.bench_e2e(batch_size=2048, seconds=0.2, capacity=10_000,
+                        num_banks=8)
+    assert r["events_per_sec"] > 0
+    assert r["events"] >= 2048
+    assert r["wire"] in ("word", "seg", "delta", "bytes", "arrays")
+    assert len(r["rates"]) == 5
+
+
+def test_json_smoke():
+    r = bench.bench_json(seconds=0.2, capacity=10_000, num_banks=8,
+                         bridge_batch=1024)
+    assert r["events_per_sec"] > 0
+    assert r["bridge_events_per_sec"] > 0
+    assert r["fused_events_per_sec"] > 0
+    assert r["events"] % 1024 == 0
+
+
+def test_sharded_step_smoke():
+    r = bench.bench_sharded_step(batch_size=1024, seconds=0.2,
+                                 capacity=10_000, num_banks=8)
+    assert r["events_per_sec"] > 0
+
+
+def test_wires_smoke():
+    r = bench.bench_wires(seconds=0.2, capacity=10_000, num_banks=8,
+                          frame_size=2048)
+    per = r["per_wire_events_per_sec"]
+    assert set(per) == {"word", "seg", "delta"}
+    assert all(v > 0 for v in per.values())
+    assert r["link_bytes_per_sec"] > 0
+
+
+def test_main_emits_one_json_line(capsys, monkeypatch):
+    """The driver contract: ONE parseable JSON line with the headline
+    metric/value/unit/vs_baseline fields plus the json-ingress extra."""
+    monkeypatch.setattr(
+        sys, "argv",
+        ["bench.py", "--seconds", "0.2", "--capacity", "10000",
+         "--num-banks", "8", "--batch-size", "2048",
+         "--e2e-batch-size", "2048"])
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    line = json.loads(out[-1])
+    assert line["metric"] == "e2e_pipeline_throughput"
+    assert line["unit"] == "events/sec"
+    assert line["value"] > 0
+    assert "vs_baseline" in line
+    assert "kernel_events_per_sec" in line
+    assert "json_ingress_events_per_sec" in line
+
+
+def test_vs_baseline_share():
+    """vs_baseline compares to this run's fair share of the 8-chip
+    target: with n local devices, the denominator is 50M * n/8."""
+    import jax
+
+    n = max(1, len(jax.devices()))
+    expect = 1.0 / (bench.NORTH_STAR_EVENTS_PER_SEC
+                    * min(n, bench.TARGET_CHIPS) / bench.TARGET_CHIPS)
+    assert bench._vs_baseline(1.0) == pytest.approx(expect)
