@@ -185,7 +185,11 @@ def cmd_fused(args) -> None:
             m.valid_events, m.invalid_events = counts
         logger.info("Fused: %s",
                     m.summary(pipe.estimated_fpr(),
-                              include_validity=counts is not None))
+                              include_validity=counts is not None,
+                              # fused path always runs the blocked
+                              # layout; its occupancy estimate is a
+                              # lower bound (fast_path.estimated_fpr)
+                              fpr_is_lower_bound=True))
         analyzer = AttendanceAnalyzer(pipe.store)
         analyzer.print_insights(analyzer.generate_insights())
         for day in pipe.lecture_days():

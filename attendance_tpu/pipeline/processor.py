@@ -66,15 +66,22 @@ class ProcessorMetrics:
         return self.events / self.wall_seconds if self.wall_seconds else 0.0
 
     def summary(self, estimated_fpr: Optional[float] = None,
-                include_validity: bool = True) -> str:
+                include_validity: bool = True,
+                fpr_is_lower_bound: bool = False) -> str:
         """One metrics line (SURVEY.md §5: batch size, device time, FPR
         estimate alongside the counters). include_validity=False for
         pipelines whose validity is an async device side-output that
-        never lands in these host counters (the fused path)."""
+        never lands in these host counters (the fused path).
+        fpr_is_lower_bound marks estimates from the blocked layout,
+        whose occupancy formula understates the true FPR (per-block
+        fill variance adds a penalty the global fill^k misses) — the
+        line then prints ">=" so the number cannot be read as the
+        budget-accurate flat-layout estimate."""
         mean_batch = (sum(self.batch_sizes) / len(self.batch_sizes)
                       if self.batch_sizes else 0.0)
+        bound = ">= " if fpr_is_lower_bound else ""
         fpr = ("n/a" if estimated_fpr is None
-               else f"{estimated_fpr:.4%}")
+               else f"{bound}{estimated_fpr:.4%}")
         validity = (f"{self.valid_events} valid, "
                     f"{self.invalid_events} invalid"
                     if include_validity
@@ -350,8 +357,11 @@ class AttendanceProcessor:
                 checkpoint_and_ack()
             self.metrics.wall_seconds = time.perf_counter() - t_start
             if logger.isEnabledFor(logging.INFO):
-                logger.info("Metrics: %s",
-                            self.metrics.summary(self.estimated_fpr()))
+                logger.info("Metrics: %s", self.metrics.summary(
+                    self.estimated_fpr(),
+                    fpr_is_lower_bound=(
+                        getattr(self.config, "bloom_layout", "flat")
+                        == "blocked")))
 
     def estimated_fpr(self) -> Optional[float]:
         """Occupancy-based Bloom FPR estimate for the roster filter

@@ -159,3 +159,18 @@ def test_validity_counts_survive_snapshot_restore(tmp_path):
                              snapshot_dir=str(tmp_path / "snap")),
                       client=MemoryClient(MemoryBroker()), num_banks=8)
     assert b.validity_counts() == before
+
+
+def test_metrics_line_marks_blocked_layout_fpr_as_lower_bound():
+    """The blocked layout's occupancy FPR understates the true rate
+    (VERDICT r02 weak #6): its metrics line must print '>=' so the
+    number cannot be read as the flat layout's budget-accurate
+    estimate."""
+    from attendance_tpu.pipeline.processor import ProcessorMetrics
+
+    m = ProcessorMetrics()
+    m.events, m.batches, m.wall_seconds = 10, 1, 1.0
+    plain = m.summary(0.005)
+    bound = m.summary(0.005, fpr_is_lower_bound=True)
+    assert "est. bloom FPR 0.5000%" in plain
+    assert "est. bloom FPR >= 0.5000%" in bound
