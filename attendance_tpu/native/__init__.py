@@ -29,7 +29,13 @@ _tried = False
 
 class PreparedJsonBatch:
     """Concatenated payload buffer + offset/length tables + output
-    columns for the resumable JSON scan (HostPipe.parse_json_from)."""
+    columns for the resumable JSON scan (HostPipe.parse_json_from).
+
+    Layout note: a zero-copy pointer-array variant (ctypes c_char_p
+    array into the payload bytes) was measured 3x SLOWER to set up
+    than this join+cumsum — ctypes converts each element through the
+    interpreter (~0.7us/payload) where b"".join is one C memcpy pass
+    (~0.2us/payload amortized) — so the copy stays."""
 
     __slots__ = ("buf", "offs", "lens", "student", "day", "micros",
                  "flags")
