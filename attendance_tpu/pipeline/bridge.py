@@ -55,12 +55,24 @@ class JsonBinaryBridge:
                           or self.config.pulsar_topic + BINARY_TOPIC_SUFFIX)
         self.producer = self.client.create_producer(self.out_topic)
         self.metrics = ProcessorMetrics()
+        # Detected once: the consumer is fixed at construction, and a
+        # single flag keeps the drain and ack sites agreeing on the
+        # token shape.
+        self._raw = hasattr(self.consumer, "receive_many_raw")
 
-    def _forward(self, msgs) -> None:
-        payloads = [m.data() for m in msgs]
+    def _forward(self, payloads, acks) -> None:
+        """Convert one micro-batch and publish it.
+
+        ``payloads`` are the raw JSON bytes; ``acks`` the matching ack
+        tokens — raw ``(message_id, data, redeliveries)`` tuples on the
+        memory broker's zero-wrapper lane, Message objects otherwise
+        (see _drain). Message wrappers only materialize on the poison
+        path, which is off the steady-state budget by definition.
+        """
+        raw = self._raw
         try:
             cols = decode_json_batch_columns(payloads)
-            good = msgs
+            good = acks
         except Exception:
             # A poison payload somewhere in the batch: convert per
             # message so only the bad ones dead-letter (bounded retry,
@@ -69,14 +81,18 @@ class JsonBinaryBridge:
             # unparseable timestamp is just as poisonous as bad JSON
             # and must dead-letter, not crash the bridge into an
             # unrecoverable redelivery loop.
+            from attendance_tpu.transport.memory_broker import Message
+
             good, parts = [], []
-            for m in msgs:
+            for payload, tok in zip(payloads, acks):
                 try:
                     parts.append(columns_from_events(
-                        [decode_event(m.data())]))
-                    good.append(m)
+                        [decode_event(payload)]))
+                    good.append(tok)
                 except Exception:
-                    handle_poison(m, self.consumer, self.metrics,
+                    msg = (Message(tok[1], tok[0], tok[2]) if raw
+                           else tok)
+                    handle_poison(msg, self.consumer, self.metrics,
                                   self.config, logger, count_nack=False)
             if not good:
                 return
@@ -85,24 +101,38 @@ class JsonBinaryBridge:
         self.producer.send(encode_planar_batch(cols))
         # Ack strictly after the binary frame is published: the bridge
         # never holds the only copy of an acknowledged event.
-        acknowledge_all(self.consumer, good)
+        if raw:
+            self.consumer.acknowledge_ids([t[0] for t in good])
+        else:
+            acknowledge_all(self.consumer, good)
         self.metrics.batches += 1
         self.metrics.events += len(good)
         self.metrics.batch_sizes.append(len(good))
+
+    def _drain(self):
+        """One micro-batch as (payloads, ack_tokens). The memory
+        broker's raw lane skips Message construction entirely; clients
+        without it (real pulsar) take the Message path."""
+        if self._raw:
+            batch = collect_batch(self.consumer, self.config.batch_size,
+                                  self.config.batch_timeout_s, raw=True)
+            return [t[1] for t in batch], batch
+        msgs = collect_batch(self.consumer, self.config.batch_size,
+                             self.config.batch_timeout_s)
+        return [m.data() for m in msgs], msgs
 
     def run(self, max_events: Optional[int] = None,
             idle_timeout_s: float = 1.0) -> None:
         t0 = time.perf_counter()
         idle_since = time.monotonic()
         while True:
-            msgs = collect_batch(self.consumer, self.config.batch_size,
-                                 self.config.batch_timeout_s)
-            if not msgs:
+            payloads, acks = self._drain()
+            if not payloads:
                 if time.monotonic() - idle_since > idle_timeout_s:
                     break
                 continue
             idle_since = time.monotonic()
-            self._forward(msgs)
+            self._forward(payloads, acks)
             if max_events is not None and self.metrics.events >= max_events:
                 break
         self.metrics.wall_seconds = time.perf_counter() - t0

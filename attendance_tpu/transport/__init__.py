@@ -52,7 +52,8 @@ def handle_poison(msg, consumer, metrics, config, logger, *,
         consumer.negative_acknowledge(msg)
 
 
-def collect_batch(consumer, batch_size: int, timeout_s: float) -> list:
+def collect_batch(consumer, batch_size: int, timeout_s: float,
+                  raw: bool = False) -> list:
     """Fill a micro-batch from a consumer: up to ``batch_size`` messages,
     or whatever arrived when ``timeout_s`` expires (partial batch).
     Shared by every micro-batching consumer (processor, bridge) so the
@@ -62,10 +63,14 @@ def collect_batch(consumer, batch_size: int, timeout_s: float) -> list:
     broker's receive_many drains pending messages under a single lock —
     per-message receive() tops out ~0.25M msg/s on lock round-trips
     alone); per-message receive is the fallback for clients without it
-    (the gated real-Pulsar wrapper)."""
+    (the gated real-Pulsar wrapper). ``raw=True`` selects the memory
+    broker's zero-wrapper lane — ``(message_id, data, redeliveries)``
+    tuples instead of Message objects; the caller must have
+    feature-detected receive_many_raw."""
     import time
 
-    batch_recv = getattr(consumer, "receive_many", None)
+    batch_recv = (consumer.receive_many_raw if raw
+                  else getattr(consumer, "receive_many", None))
     msgs = []
     deadline = time.monotonic() + timeout_s
     while len(msgs) < batch_size:

@@ -70,12 +70,14 @@ class _Subscription:
                 owner: int) -> Message:
         return self.receive_many(1, timeout_s, owner)[0]
 
-    def receive_many(self, max_n: int, timeout_s: Optional[float],
-                     owner: int) -> list:
-        """Drain up to max_n pending messages under ONE lock acquisition
-        (Pulsar batch_receive semantics). Blocks until at least one
-        message is available or the timeout expires; receive() is the
-        max_n=1 special case."""
+    def receive_many_raw(self, max_n: int, timeout_s: Optional[float],
+                         owner: int) -> list:
+        """Drain up to max_n pending messages under ONE lock
+        acquisition, returning raw ``(message_id, data, redeliveries)``
+        tuples — the zero-wrapper lane for batching consumers whose
+        per-event budget is microseconds (the JSON bridge). Blocks
+        until at least one message is available or the timeout
+        expires."""
         deadline = (None if timeout_s is None
                     else time.monotonic() + timeout_s)
         with self.cond:
@@ -90,7 +92,7 @@ class _Subscription:
                     raise ReceiveTimeout(
                         f"no message within {timeout_s}s on {self.name!r}")
                 self.cond.wait(remaining)
-            # Bulk-pop then two comprehensions: at JSON-wire rates this
+            # Bulk-pop then comprehensions: at JSON-wire rates this
             # loop IS the receive cost (hundreds of thousands of
             # per-message iterations/s), and comprehension + dict.update
             # run ~2x the interpreted append-per-message form.
@@ -98,7 +100,14 @@ class _Subscription:
             popped = [self.pending.popleft() for _ in range(k)]
             self.inflight.update(
                 (mid, (data, red, owner)) for mid, data, red in popped)
-            return [Message(data, mid, red) for mid, data, red in popped]
+            return popped
+
+    def receive_many(self, max_n: int, timeout_s: Optional[float],
+                     owner: int) -> list:
+        """Like receive_many_raw, wrapped in Message objects (the
+        Pulsar batch_receive shape); receive() is the max_n=1 case."""
+        return [Message(data, mid, red) for mid, data, red
+                in self.receive_many_raw(max_n, timeout_s, owner)]
 
     def acknowledge(self, message_id: int) -> None:
         with self.cond:
@@ -234,6 +243,21 @@ class MemoryConsumer:
             raise RuntimeError("consumer closed")
         timeout_s = None if timeout_millis is None else timeout_millis / 1e3
         return self._sub.receive_many(max_n, timeout_s, self._id)
+
+    def receive_many_raw(self, max_n: int,
+                         timeout_millis: Optional[int] = None) -> list:
+        """Batch receive as raw (message_id, data, redeliveries)
+        tuples — no Message wrappers. Ack with acknowledge_ids;
+        reconstruct a Message(data, message_id, redeliveries) only on
+        the poison path. Memory-broker extension (the real pulsar
+        client has no such lane; callers feature-detect)."""
+        if self._closed:
+            raise RuntimeError("consumer closed")
+        timeout_s = None if timeout_millis is None else timeout_millis / 1e3
+        return self._sub.receive_many_raw(max_n, timeout_s, self._id)
+
+    def acknowledge_ids(self, message_ids) -> None:
+        self._sub.acknowledge_many(message_ids)
 
     def acknowledge(self, msg: Message) -> None:
         self._sub.acknowledge(msg.message_id)
