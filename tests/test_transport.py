@@ -119,3 +119,39 @@ def test_cross_thread_delivery():
     client.create_producer("t").send(b"threaded")
     th.join(timeout=5)
     assert got == [b"threaded"]
+
+
+def test_raw_drain_lane_bookkeeping():
+    """receive_many_raw returns (id, payload, redeliveries) tuples with
+    the SAME inflight bookkeeping as the Message lane: acknowledge_ids
+    clears them, a reconstructed Message nacks for redelivery, and a
+    consumer crash requeues raw-delivered messages for takeover."""
+    from attendance_tpu.transport.memory_broker import Message
+
+    client = make_client()
+    consumer = client.subscribe("t", "sub")
+    prod = client.create_producer("t")
+    for i in range(6):
+        prod.send(b"m%d" % i)
+
+    batch = consumer.receive_many_raw(4, timeout_millis=200)
+    assert [t[1] for t in batch] == [b"m0", b"m1", b"m2", b"m3"]
+    assert all(t[2] == 0 for t in batch)  # first delivery
+
+    # Ack two by id; nack one via a reconstructed Message; leave one
+    # in flight and crash.
+    consumer.acknowledge_ids([batch[0][0], batch[1][0]])
+    consumer.negative_acknowledge(Message(batch[2][1], batch[2][0],
+                                          batch[2][2]))
+    redelivered = consumer.receive_many_raw(10, timeout_millis=200)
+    # m4, m5 still pending plus the nacked m2 with a bumped count.
+    got = {t[1]: t[2] for t in redelivered}
+    assert got[b"m2"] == 1 and got[b"m4"] == 0 and got[b"m5"] == 0
+
+    consumer.close()  # m3 + everything unacked requeues for takeover
+    c2 = client.subscribe("t", "sub")
+    taken = c2.receive_many_raw(10, timeout_millis=500)
+    assert {t[1] for t in taken} == {b"m2", b"m3", b"m4", b"m5"}
+    assert all(t[2] >= 1 for t in taken)  # all are redeliveries now
+    c2.acknowledge_ids([t[0] for t in taken])
+    assert c2.backlog() == 0
