@@ -12,6 +12,19 @@ def test_pipeline_subcommand_memory_backend(capsys):
     assert "Invalid Attendance Attempts" in out
 
 
+def test_pipeline_subcommand_redis_sim_backend(capsys):
+    """The Redis-algorithm simulation is a full execution backend, not
+    just the parity oracle: the whole reference pipeline (generate ->
+    process -> analyze) runs on it and produces the reference's five
+    insights."""
+    main(["pipeline", "--sketch-backend", "redis-sim",
+          "--num-students", "40", "--num-invalid", "5", "--seed", "1",
+          "--batch-size", "128", "--batch-timeout-s", "0.01"])
+    out = capsys.readouterr().out
+    assert "Habitual Latecomers" in out
+    assert "Invalid Attendance Attempts" in out
+
+
 def test_analyze_subcommand_empty(capsys):
     main(["analyze", "--sketch-backend", "memory"])
     assert "No insights available" in capsys.readouterr().out
