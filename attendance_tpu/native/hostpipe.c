@@ -612,9 +612,77 @@ static inline int key_is(const uint8_t *k, uint32_t klen, const char *name) {
  * [offs[i], offs[i] + lens[i])) into the binary columns.  flags bit0 =
  * is_valid, bit1 = exit.  Returns 0, or 1 + index of the first payload
  * that falls outside the fast shape (caller re-parses via Python). */
+/* Fixed-layout fast path: the reference producer emits every event via
+ * json.dumps with default separators, so the overwhelming majority of
+ * payloads match ONE byte layout:
+ *   {"student_id": N, "timestamp": "T", "lecture_id": "L",
+ *    "is_valid": B, "event_type": "E"}
+ * This path memcmp's the literal fragments and parses only the value
+ * spans; any deviation (key order, spacing, escapes, extra keys)
+ * returns nonzero and the caller falls through to the general grammar
+ * — behavior is identical, this is purely a cheaper first try. */
+#define ATP_LIT(lit)                                                   \
+    do {                                                               \
+        size_t L_ = sizeof(lit) - 1;                                   \
+        if ((size_t)(end - p) < L_ || memcmp(p, lit, L_)) return 1;    \
+        p += L_;                                                       \
+    } while (0)
+
+static int parse_fixed_layout(const uint8_t *p, const uint8_t *end,
+                              uint32_t *student, uint32_t *day,
+                              int64_t *micros, uint8_t *flags) {
+    ATP_LIT("{\"student_id\": ");
+    uint64_t v;
+    int d = parse_uint(p, end, &v);
+    if (!d || (d > 1 && *p == '0')) return 1;
+    p += d;
+    ATP_LIT(", \"timestamp\": ");
+    /* String fields go through parse_plain_string — ONE definition of
+     * the acceptance predicate (escapes, control bytes, non-ASCII all
+     * bail to the fallback, which mirrors json.loads). The empty-span
+     * guard closes the 0 == 0 hole: parse_iso_micros returns 0 for
+     * failure AND consumes 0 bytes of an empty string, but Python's
+     * fromisoformat("") raises, so empty must never fast-parse. */
+    const uint8_t *ts;
+    uint32_t tslen;
+    int c1 = parse_plain_string(p, end, &ts, &tslen);
+    if (!c1) return 1;
+    int64_t us;
+    if (tslen == 0 || parse_iso_micros(ts, ts + tslen, &us) != (int)tslen)
+        return 1;
+    p += c1;
+    ATP_LIT(", \"lecture_id\": ");
+    const uint8_t *lid;
+    uint32_t lidlen;
+    int c2 = parse_plain_string(p, end, &lid, &lidlen);
+    if (!c2) return 1;
+    uint32_t day_v;
+    if (!lecture_day_from_id(lid, lidlen, &day_v)) return 1;
+    p += c2;
+    ATP_LIT(", \"is_valid\": ");
+    uint8_t fl;
+    if (end - p >= 4 && !memcmp(p, "true", 4)) { fl = 1; p += 4; }
+    else if (end - p >= 5 && !memcmp(p, "false", 5)) { fl = 0; p += 5; }
+    else return 1;
+    ATP_LIT(", \"event_type\": \"");
+    if (end - p >= 6 && !memcmp(p, "entry\"", 6)) { p += 6; }
+    else if (end - p >= 5 && !memcmp(p, "exit\"", 5)) { fl |= 2; p += 5; }
+    else return 1;
+    if (p >= end || *p != '}') return 1;
+    ++p;
+    if (p != end) return 1;  /* trailing bytes: general path decides */
+    *student = (uint32_t)(v & 0xFFFFFFFFu);
+    *micros = us;
+    *day = day_v;
+    *flags = fl;
+    return 0;
+}
+
 static int parse_one_json_event(const uint8_t *p, const uint8_t *end,
                                 uint32_t *student, uint32_t *day,
                                 int64_t *micros, uint8_t *flags) {
+    if (!parse_fixed_layout(p, end, student, day, micros, flags))
+        return 0;
     int seen = 0; /* bit per required field */
     int after_comma = 0;
     uint8_t fl = 0;
@@ -651,7 +719,12 @@ static int parse_one_json_event(const uint8_t *p, const uint8_t *end,
             int c2 = parse_plain_string(p, end, &s, &slen);
             if (!c2) return 1;
             int64_t us;
-            if (parse_iso_micros(s, s + slen, &us) != (int)slen)
+            /* slen == 0 guard: parse_iso_micros returns 0 on failure,
+             * which equals the consumed count of an empty string —
+             * but fromisoformat("") raises in the Python codec, so
+             * empty must be refused here too. */
+            if (slen == 0
+                || parse_iso_micros(s, s + slen, &us) != (int)slen)
                 return 1;
             *micros = us;
             p += c2;

@@ -309,3 +309,21 @@ def test_json_scanner_differential_fuzz():
         agree += 1
     # sanity: the fuzz actually exercised both outcomes
     assert mutations == 3000 and 0 < agree < mutations
+
+
+def test_empty_timestamp_rejected_like_python():
+    """fromisoformat('') raises in the Python codec; the native scan
+    (both the fixed-layout fast path and the general grammar) must
+    refuse an empty timestamp rather than ingest an indeterminate
+    micros value (the 0-consumed == 0-length hole)."""
+    from attendance_tpu.pipeline.events import decode_json_batch_columns
+
+    fixed_layout = (b'{"student_id": 1, "timestamp": "", '
+                    b'"lecture_id": "LECTURE_20260101", '
+                    b'"is_valid": true, "event_type": "entry"}')
+    off_layout = (b'{"timestamp": "", "student_id": 1, '
+                  b'"lecture_id": "LECTURE_20260101", '
+                  b'"is_valid": true, "event_type": "entry"}')
+    for payload in (fixed_layout, off_layout):
+        with pytest.raises(Exception):
+            decode_json_batch_columns([payload])
