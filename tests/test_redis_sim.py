@@ -251,3 +251,25 @@ class TestTpuVsRedisSimParity:
         allow = 0.01 + 3 * np.sqrt(0.01 * 0.99 / len(probe))
         assert fp_tpu <= allow, fp_tpu
         assert fp_sim <= allow, fp_sim
+
+
+@pytest.mark.parametrize("make_store", [
+    _sim,
+    lambda: __import__("attendance_tpu.sketch.tpu_store",
+                       fromlist=["TpuSketchStore"]).TpuSketchStore(
+        Config(sketch_backend="tpu")),
+], ids=["redis-sim", "tpu"])
+def test_scaling_chain_keeps_compound_fpr_budget(make_store):
+    """Auto-scaling exists to BOUND error, not just to fit keys: with
+    per-level error tightening (e0/2^i), the whole chain's FPR stays
+    <= ~2*e0 no matter how far an implicit filter grows past its
+    default capacity (RedisBloom's own guarantee). 50x overflow of the
+    default-100 filter, probed with a disjoint population."""
+    store = make_store()
+    keys = np.arange(10_000, 15_000, dtype=np.uint32)  # 50x default cap
+    store.bf_add_many("auto", keys)
+    assert store.bf_exists_many("auto", keys).all()  # never lose members
+    probe = np.arange(1_000_000, 1_040_000, dtype=np.uint32)
+    fpr = float(store.bf_exists_many("auto", probe).mean())
+    e0 = 0.01  # DEFAULT_ERROR_RATE
+    assert fpr <= 2 * e0 + 3 * np.sqrt(2 * e0 * (1 - 2 * e0) / len(probe)), fpr
