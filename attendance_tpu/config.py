@@ -102,6 +102,13 @@ class Config:
     # falls behind — see fast_path._auto_wire; requires the native
     # host runtime to narrow). "delta"/"seg"/"word"/"bytes" force one.
     wire_format: str = "auto"
+    # Optional side topic for computed-invalid events ("" = disabled).
+    # The reference's README promises an "attendance-invalid" routing
+    # topic its code never implements (README.md:163,262; SURVEY.md
+    # §0.3 item 4). When set, the generic processor REPUBLISHES each
+    # invalid event there (reference JSON wire) in addition to the
+    # code-contract behavior of storing it with is_valid=false.
+    invalid_topic: str = ""
     # Poison-message handling: a frame that fails decode/processing is
     # nacked for redelivery at most this many times, then dead-lettered
     # (acked + counted). The reference nacks forever (no DLQ despite its
@@ -177,6 +184,9 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    default=d.wire_format,
                    help="fused-path host->device wire (auto adapts "
                    "word->seg->delta from observed backpressure)")
+    p.add_argument("--invalid-topic", default=d.invalid_topic,
+                   help="side topic for computed-invalid events (the "
+                   "README-promised attendance-invalid DLQ; empty = off)")
     p.add_argument("--max-redeliveries", type=int, default=d.max_redeliveries)
     p.add_argument("--profile-dir", default=d.profile_dir,
                    help="write a jax.profiler trace of the run here")
@@ -208,6 +218,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         snapshot_dir=args.snapshot_dir,
         snapshot_every_batches=args.snapshot_every_batches,
         wire_format=args.wire_format,
+        invalid_topic=args.invalid_topic,
         max_redeliveries=args.max_redeliveries,
         profile_dir=args.profile_dir,
     ).validate()

@@ -116,6 +116,16 @@ class AttendanceProcessor:
         self.store = event_store or make_event_store(self.config)
         self.metrics = ProcessorMetrics()
         self._profiling = bool(self.config.profile_dir)
+        # Optional invalid-event side topic (config.invalid_topic): the
+        # reference's README promises an "attendance-invalid" routing
+        # topic its code never implements (README.md:163,262 vs
+        # attendance_processor.py:115-129 — SURVEY.md §0.3 item 4); the
+        # code-as-truth behavior (invalid rows stored with
+        # is_valid=false) is unchanged, this additionally REPUBLISHES
+        # each computed-invalid event for downstream alerting.
+        self._invalid_producer = (
+            self.client.create_producer(self.config.invalid_topic)
+            if getattr(self.config, "invalid_topic", "") else None)
         # Checkpoint/restore (SURVEY.md §5): honored when snapshot_dir is
         # set. Sketch state snapshots through utils.snapshot; the event
         # store participates when it supports save/load (memory/columnar
@@ -247,6 +257,16 @@ class AttendanceProcessor:
                     f"{self.config.hll_key_prefix}{lecture_id}",
                     np.array(members, dtype=np.int64))
         self.metrics.device_seconds += time.perf_counter() - t1
+
+        # 4. Optional invalid routing (README-promised DLQ topic): each
+        #    computed-invalid event republished on the side topic, in
+        #    the reference's own JSON wire format. Off the main
+        #    contract (storage keeps the is_valid=false row either way).
+        if self._invalid_producer is not None:
+            from attendance_tpu.pipeline.events import encode_event
+            for e, v in zip(events, is_valid):
+                if not v:
+                    self._invalid_producer.send(encode_event(e))
 
         nv = int(is_valid.sum())
         self.metrics.batches += 1

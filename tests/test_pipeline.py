@@ -236,3 +236,51 @@ def test_analyzer_matches_pandas_oracle():
         ranked.head(3).tolist())
     assert set(got["least_attended"].values()) == set(
         ranked.tail(3).tolist())
+
+
+def test_invalid_topic_routes_computed_invalid_events():
+    """The README-promised attendance-invalid routing topic (SURVEY
+    §0.3 item 4, a sanctioned stretch feature): with
+    config.invalid_topic set, every COMPUTED-invalid event is
+    republished there in the reference JSON wire, while the
+    code-contract behavior (row stored with is_valid=false) is
+    unchanged. Validity is the Bloom verdict, not the generator flag."""
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.events import decode_event, encode_event
+    from attendance_tpu.pipeline.generator import generate_student_data
+    from attendance_tpu.pipeline.processor import AttendanceProcessor
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(sketch_backend="memory", transport_backend="memory",
+                    invalid_topic="attendance-invalid")
+    broker = MemoryBroker()
+    proc = AttendanceProcessor(config, client=MemoryClient(broker))
+    producer = MemoryClient(broker).create_producer(config.pulsar_topic)
+    report = generate_student_data(
+        producer=producer, sketch_store=proc.sketch,
+        bloom_key=config.bloom_filter_key, num_students=30,
+        num_invalid=6, seed=3)
+    proc.process_attendance(max_events=report.message_count,
+                            idle_timeout_s=0.3)
+
+    from attendance_tpu.transport.memory_broker import ReceiveTimeout
+
+    side = MemoryClient(broker).subscribe("attendance-invalid", "dlq")
+    routed = []
+    while True:
+        try:
+            batch = side.receive_many(1024, timeout_millis=50)
+        except ReceiveTimeout:
+            break
+        routed.extend(decode_event(m.data()) for m in batch)
+        for m in batch:
+            side.acknowledge(m)
+    stored_invalid = [r for r in proc.store.scan_all() if not r.is_valid]
+    assert routed, "no invalid events routed"
+    assert len(routed) == len(stored_invalid)
+    assert {e.student_id for e in routed} == \
+        {r.student_id for r in stored_invalid}
+    # Round-trip stability: routed payloads are the reference wire.
+    assert decode_event(encode_event(routed[0])).student_id \
+        == routed[0].student_id
