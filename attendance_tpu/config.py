@@ -90,6 +90,12 @@ class Config:
     # while acking would lose events).
     snapshot_dir: str = ""
     snapshot_every_batches: int = 0
+    # Structured metrics sink ("" = disabled): append ONE JSON line of
+    # run metrics (ProcessorMetrics.to_dict) per processor/bridge run —
+    # the machine-readable counterpart of the human metrics log line
+    # (the reference's README narrates "structured logging" without
+    # implementing it; SURVEY.md §5).
+    metrics_json: str = ""
     # Profiling ("" = disabled): directory for a jax.profiler trace of
     # the processing run (TensorBoard/XProf-loadable). Device dispatches
     # are TraceAnnotation-labelled so kernel time attributes to stages.
@@ -190,6 +196,8 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
     p.add_argument("--max-redeliveries", type=int, default=d.max_redeliveries)
     p.add_argument("--profile-dir", default=d.profile_dir,
                    help="write a jax.profiler trace of the run here")
+    p.add_argument("--metrics-json", default=d.metrics_json,
+                   help="append one JSON metrics line per run here")
     return p
 
 
@@ -221,4 +229,5 @@ def config_from_args(args: argparse.Namespace) -> Config:
         invalid_topic=args.invalid_topic,
         max_redeliveries=args.max_redeliveries,
         profile_dir=args.profile_dir,
+        metrics_json=args.metrics_json,
     ).validate()

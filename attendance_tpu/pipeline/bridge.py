@@ -139,6 +139,8 @@ class JsonBinaryBridge:
         if logger.isEnabledFor(logging.INFO):
             logger.info("Bridge metrics: %s",
                         self.metrics.summary(None, include_validity=False))
+        if getattr(self.config, "metrics_json", ""):
+            self.metrics.write_json_line(self.config.metrics_json)
 
     def cleanup(self) -> None:
         self.client.close()

@@ -1006,6 +1006,11 @@ class FusedPipeline:
             logger.info("Fused metrics: %s",
                         self.metrics.summary(None,
                                              include_validity=False))
+        if getattr(self.config, "metrics_json", ""):
+            # estimated_fpr stays None: computing it forces the D2H
+            # read the platform note above forbids mid-process.
+            self.metrics.write_json_line(self.config.metrics_json,
+                                         fpr_is_lower_bound=True)
 
     def _run_loop(self, max_events: Optional[int],
                   idle_timeout_s: float, idle_since: float) -> None:

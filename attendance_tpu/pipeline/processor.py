@@ -65,6 +65,38 @@ class ProcessorMetrics:
     def events_per_second(self) -> float:
         return self.events / self.wall_seconds if self.wall_seconds else 0.0
 
+    def to_dict(self, estimated_fpr: Optional[float] = None,
+                fpr_is_lower_bound: bool = False) -> Dict:
+        """Machine-readable form of the metrics line — the structured
+        counterpart of :meth:`summary` for the JSON-lines sink
+        (config.metrics_json). One flat dict, JSON-serializable."""
+        return {
+            "events": self.events,
+            "batches": self.batches,
+            "events_per_second": round(self.events_per_second, 1),
+            "mean_batch": round(sum(self.batch_sizes)
+                                / len(self.batch_sizes), 1)
+            if self.batch_sizes else 0.0,
+            "device_seconds": round(self.device_seconds, 6),
+            "wall_seconds": round(self.wall_seconds, 6),
+            "valid_events": self.valid_events,
+            "invalid_events": self.invalid_events,
+            "nacked_batches": self.nacked_batches,
+            "dead_lettered": self.dead_lettered,
+            "estimated_fpr": estimated_fpr,
+            "fpr_is_lower_bound": fpr_is_lower_bound,
+            "wire_dwell": dict(self.wire_dwell),
+        }
+
+    def write_json_line(self, path: str, **to_dict_kwargs) -> None:
+        """Append one JSON metrics line to ``path`` (the structured-
+        logging surface the reference's README narrates but never
+        implements, SURVEY.md §5)."""
+        import json
+
+        with open(path, "a") as f:
+            f.write(json.dumps(self.to_dict(**to_dict_kwargs)) + "\n")
+
     def summary(self, estimated_fpr: Optional[float] = None,
                 include_validity: bool = True,
                 fpr_is_lower_bound: bool = False) -> str:
@@ -376,12 +408,16 @@ class AttendanceProcessor:
             if pending_acks:
                 checkpoint_and_ack()
             self.metrics.wall_seconds = time.perf_counter() - t_start
+            blocked = (getattr(self.config, "bloom_layout", "flat")
+                       == "blocked")
             if logger.isEnabledFor(logging.INFO):
                 logger.info("Metrics: %s", self.metrics.summary(
-                    self.estimated_fpr(),
-                    fpr_is_lower_bound=(
-                        getattr(self.config, "bloom_layout", "flat")
-                        == "blocked")))
+                    self.estimated_fpr(), fpr_is_lower_bound=blocked))
+            if getattr(self.config, "metrics_json", ""):
+                self.metrics.write_json_line(
+                    self.config.metrics_json,
+                    estimated_fpr=self.estimated_fpr(),
+                    fpr_is_lower_bound=blocked)
 
     def estimated_fpr(self) -> Optional[float]:
         """Occupancy-based Bloom FPR estimate for the roster filter

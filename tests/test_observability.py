@@ -174,3 +174,40 @@ def test_metrics_line_marks_blocked_layout_fpr_as_lower_bound():
     bound = m.summary(0.005, fpr_is_lower_bound=True)
     assert "est. bloom FPR 0.5000%" in plain
     assert "est. bloom FPR >= 0.5000%" in bound
+
+
+def test_metrics_json_sink_appends_one_line_per_run(tmp_path):
+    """config.metrics_json: both processors append ONE machine-readable
+    JSON line per run — the structured-logging surface the reference's
+    README narrates without implementing (SURVEY §5)."""
+    import json
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    path = tmp_path / "metrics.jsonl"
+    config = Config(transport_backend="memory",
+                    bloom_filter_capacity=10_000,
+                    metrics_json=str(path))
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    roster, frames = generate_frames(4096, 1024, roster_size=4_000,
+                                     num_lectures=4, seed=9)
+    pipe.preload(roster)
+    prod = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        prod.send(f)
+    pipe.run(max_events=4096, idle_timeout_s=0.3)
+    pipe.run(max_events=0, idle_timeout_s=0.1)  # second run, second line
+
+    lines = [json.loads(x) for x in path.read_text().splitlines()]
+    assert len(lines) == 2
+    first = lines[0]
+    assert first["events"] == 4096
+    assert first["events_per_second"] > 0
+    assert first["wire_dwell"]  # which wire carried the frames
+    assert first["fpr_is_lower_bound"] is True
+    assert first["estimated_fpr"] is None  # deferred on the fused path
