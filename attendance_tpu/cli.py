@@ -192,9 +192,10 @@ def cmd_fused(args) -> None:
                               fpr_is_lower_bound=True))
         analyzer = AttendanceAnalyzer(pipe.store)
         analyzer.print_insights(analyzer.generate_insights())
+        counts = pipe.count_all()  # one device pass for every bank
         for day in pipe.lecture_days():
             logger.info("LECTURE_%d: %d unique attendees", day,
-                        pipe.count(day))
+                        counts[day])
     finally:
         pipe.cleanup()
 

@@ -1111,6 +1111,23 @@ class FusedPipeline:
         return int(round(estimate_from_histogram(
             hist, self.config.hll_precision)))
 
+    def count_all(self) -> Dict[int, int]:
+        """PFCOUNT of every registered lecture day in ONE device pass
+        (one histogram over all banks instead of a dispatch per day) —
+        the batch counterpart of :meth:`count`, matching the sharded
+        engine's count_all."""
+        if not self._bank_of:
+            return {}
+        if self.sharded:
+            ests = self.engine.count_all()
+            return {day: int(ests[bank])
+                    for day, bank in self._bank_of.items()}
+        hists = np.asarray(best_histogram(self.state.hll_regs,
+                                          self.config.hll_precision))
+        return {day: int(round(estimate_from_histogram(
+            hists[bank], self.config.hll_precision)))
+            for day, bank in self._bank_of.items()}
+
     def cleanup(self) -> None:
         self.client.close()
         self.store.close()

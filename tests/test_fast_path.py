@@ -274,3 +274,27 @@ def test_competing_fused_pipelines_merge_to_one_answer():
         hist = np.asarray(best_histogram(merged[None, :], 14))[0]
         est = int(round(estimate_from_histogram(hist, 14)))
         assert est == ref_counts[day], (day, est, ref_counts[day])
+
+
+def test_count_all_matches_per_day_counts():
+    """count_all (one histogram pass over every bank) must agree with
+    per-day count() on both engines."""
+    num_events, batch = 8_192, 2_048
+    roster, frames = generate_frames(num_events, batch, roster_size=5_000,
+                                     num_lectures=6, seed=43)
+    frames = list(frames)
+    for shards, reps in ((1, 1), (2, 2)):
+        config = Config(bloom_filter_capacity=20_000,
+                        transport_backend="memory",
+                        num_shards=shards, num_replicas=reps)
+        client = MemoryClient(MemoryBroker())
+        pipe = FusedPipeline(config, client=client, num_banks=8)
+        pipe.preload(roster)
+        prod = client.create_producer(config.pulsar_topic)
+        for f in frames:
+            prod.send(f)
+        pipe.run(max_events=num_events, idle_timeout_s=0.4)
+        batch_counts = pipe.count_all()
+        assert set(batch_counts) == set(pipe.lecture_days())
+        for day in pipe.lecture_days():
+            assert batch_counts[day] == pipe.count(day)
