@@ -26,9 +26,14 @@ what the reference's accuracy contract (error_rate=0.01, ~0.81% HLL
 sigma) specifies.
 
 The harness is backend-agnostic: :func:`run_parity` drives any two
-SketchStore implementations (the hermetic tests pair tpu vs memory; the
-Redis-gated test and the ``parity`` CLI subcommand pair tpu vs a real
-Redis Stack when one is reachable — see :func:`check_redis`).
+SketchStore implementations. The DEFAULT hermetic oracle is the
+Redis-algorithm simulation (:func:`run_sim_parity` pairs tpu vs
+sketch.redis_sim — Redis's actual sizing/hashing/estimator with no
+hashing shared with the TPU path); the Redis-gated test and
+``parity --oracle redis`` pair tpu vs a real Redis Stack when one is
+reachable (see :func:`check_redis`). The memory-store pairing remains
+as a consistency check of the device kernels against their numpy
+mirrors.
 
 Scalar command shapes are exercised on a sample of the stream (they cost
 one RTT each against a real server); the bulk of the stream flows

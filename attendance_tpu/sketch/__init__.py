@@ -4,7 +4,7 @@ The reference talks to its sketches exclusively through redis-py call
 shapes — ``execute_command('BF.ADD'|'BF.EXISTS'|'BF.RESERVE', ...)``,
 ``pfadd``, ``pfcount`` (reference attendance_processor.py:78,83-88,109-113,
 129,152 and data_generator.py:59-63). This package keeps those call shapes
-API-stable across three interchangeable backends selected by
+API-stable across four interchangeable backends selected by
 ``--sketch-backend``:
 
   * "tpu"       — device-resident sketches, micro-batched JAX kernels
