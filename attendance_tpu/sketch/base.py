@@ -253,10 +253,22 @@ class SketchStore(abc.ABC):
 
     # -- redis-py compatible entry point ------------------------------------
     def execute_command(self, *args):
-        """The exact call shape the reference uses for BF.* commands."""
+        """The exact call shape the reference uses for BF.* commands.
+
+        Arity mistakes raise :class:`ResponseError` like a real server
+        ("wrong number of arguments"), not a bare unpacking ValueError —
+        callers written against redis-py catch exactly one type.
+        """
         if not args:
             raise ResponseError("empty command")
         cmd = str(args[0]).upper()
+        try:
+            return self._dispatch_command(cmd, args)
+        except (ValueError, TypeError) as e:
+            raise ResponseError(
+                f"wrong number of arguments for {cmd!r}") from e
+
+    def _dispatch_command(self, cmd: str, args):
         if cmd == "BF.RESERVE":
             _, key, error_rate, capacity = args
             return self.bf_reserve(str(key), error_rate, capacity)

@@ -117,3 +117,26 @@ def test_factory_selects_backend():
                       TpuSketchStore)
     assert isinstance(make_sketch_store(Config(sketch_backend="memory")),
                       MemorySketchStore)
+
+
+def test_execute_command_arity_errors_are_response_errors():
+    """A real server answers arity mistakes with a command-level error;
+    the facade must raise ResponseError, never a bare unpacking
+    ValueError — redis-py-written callers catch exactly one type."""
+    import pytest
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.sketch.base import ResponseError
+    from attendance_tpu.sketch.memory_store import MemorySketchStore
+    from attendance_tpu.sketch.redis_sim import RedisSimSketchStore
+
+    for store in (MemorySketchStore(Config(sketch_backend="memory")),
+                  RedisSimSketchStore(Config(sketch_backend="redis-sim"))):
+        with pytest.raises(ResponseError):
+            store.execute_command("BF.RESERVE", "k", 0.01)  # missing cap
+        with pytest.raises(ResponseError):
+            store.execute_command("BF.ADD", "k")            # missing member
+        with pytest.raises(ResponseError):
+            store.execute_command("BF.EXISTS", "k", "a", "b")  # extra
+        with pytest.raises(ResponseError):
+            store.execute_command("NOT.A.COMMAND", "k")
