@@ -108,7 +108,10 @@ def cmd_stats(args) -> None:
     """PFCOUNT + partition scan for one lecture — the reference's
     get_attendance_stats query surface (reference
     attendance_processor.py:149-165) as a standalone subcommand against
-    the configured sketch/storage backends."""
+    the configured sketch/storage backends. ``--student-id`` instead
+    answers the per-student access pattern the reference's README
+    promises via its never-created events_by_student_day table
+    (README.md:124-148; SURVEY.md §0.3 item 3)."""
     from attendance_tpu.sketch import make_sketch_store
     from attendance_tpu.storage import make_event_store
 
@@ -118,6 +121,23 @@ def cmd_stats(args) -> None:
         store = _store_for_events_file(config, args.events_file)
     else:
         store = make_event_store(config)
+    if args.student_id is None and not args.lecture_id:
+        import sys
+
+        logger.error("stats needs a lecture_id or --student-id")
+        sys.exit(2)
+    if args.student_id is not None:
+        records = store.scan_student(args.student_id)
+        if isinstance(records, dict):
+            lectures = sorted(set(records["lecture_day"].tolist()))
+            n, nv = (len(records["student_id"]),
+                     int(sum(records["is_valid"])))
+        else:
+            lectures = sorted({r.lecture_id for r in records})
+            n, nv = len(records), sum(1 for r in records if r.is_valid)
+        print(f"Student {args.student_id}: {n} attendance records "
+              f"({nv} valid) across {len(lectures)} lectures")
+        return
     unique = sketch.pfcount(
         f"{config.hll_key_prefix}{args.lecture_id}")
     records = store.scan_lecture(args.lecture_id)
@@ -301,10 +321,16 @@ def main(argv=None) -> None:
 
     p_st = sub.add_parser(
         "stats", help="PFCOUNT + partition scan for one lecture "
-        "(the reference's get_attendance_stats query)")
+        "(the reference's get_attendance_stats query), or a per-student "
+        "record summary with --student-id")
     add_flags(p_st)
-    p_st.add_argument("lecture_id", help="reference-style lecture id, "
+    p_st.add_argument("lecture_id", nargs="?", default="",
+                      help="reference-style lecture id, "
                       "e.g. LECTURE_20260101")
+    p_st.add_argument("--student-id", type=int, default=None,
+                      help="per-student summary instead of a lecture "
+                      "scan (the README-promised events_by_student_day "
+                      "access pattern)")
     p_st.add_argument("--events-file", default="",
                       help="load events from a saved store file first")
     p_st.set_defaults(fn=cmd_stats)

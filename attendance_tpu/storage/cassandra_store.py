@@ -95,6 +95,25 @@ class CassandraEventStore:
                               event_type="entry")
                 for r in rows]
 
+    def scan_student(self, student_id: int) -> List[AttendanceRow]:
+        """Per-student filtered scan — the access pattern of the
+        README-promised ``events_by_student_day`` table
+        (README.md:124-148), served from the one real table with the
+        same ALLOW FILTERING the analyzer's reads use
+        (attendance_analysis.py:33-39)."""
+        rows = self.session.execute(
+            "SELECT student_id, lecture_id, timestamp, is_valid "
+            "FROM attendance WHERE student_id = %s ALLOW FILTERING",
+            (int(student_id),))
+        return sorted(
+            (AttendanceRow(student_id=r.student_id,
+                           timestamp=r.timestamp.isoformat(),
+                           lecture_id=r.lecture_id,
+                           is_valid=r.is_valid,
+                           event_type="entry")
+             for r in rows),
+            key=lambda r: (r.lecture_id, r.timestamp))
+
     def scan_all(self) -> List[AttendanceRow]:
         out: List[AttendanceRow] = []
         for lecture_id in self.distinct_lecture_ids():

@@ -147,6 +147,16 @@ class ColumnarEventStore:
         sel = np.asarray(cols["lecture_day"], np.int64) == int(lecture_day)
         return {name: np.asarray(arr)[sel] for name, arr in cols.items()}
 
+    def scan_student(self, student_id: int) -> Dict[str, np.ndarray]:
+        """One student's (deduped) columns across every lecture — the
+        per-student access pattern of the README-promised
+        ``events_by_student_day`` table (README.md:124-148; SURVEY.md
+        §0.3 item 3), as a columnar mask over the one real table."""
+        cols = self.to_columns()
+        sel = (np.asarray(cols["student_id"], np.int64)
+               == int(student_id))
+        return {name: np.asarray(arr)[sel] for name, arr in cols.items()}
+
     # -- row-store interface adapters ---------------------------------------
     # The generic processor and CLI speak the row-store vocabulary
     # (insert_batch of AttendanceRow, string lecture ids); these adapters

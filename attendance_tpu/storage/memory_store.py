@@ -65,6 +65,20 @@ class MemoryEventStore:
             part = self._parts.get(lecture_id, {})
             return [part[k] for k in sorted(part)]
 
+    def scan_student(self, student_id: int) -> List[AttendanceRow]:
+        """Every row of one student, ordered (lecture_id, timestamp) —
+        the per-student access pattern the reference's README promises
+        via a second ``events_by_student_day`` table it never creates
+        (README.md:124-148; SURVEY.md §0.3 item 3). Implemented as a
+        filtered scan over the one real table, like the analyzer's own
+        ALLOW FILTERING reads."""
+        sid = int(student_id)
+        out: List[AttendanceRow] = []
+        for lecture_id in self.distinct_lecture_ids():
+            out.extend(r for r in self.scan_lecture(lecture_id)
+                       if r.student_id == sid)
+        return out
+
     def scan_all(self) -> List[AttendanceRow]:
         """Full-table scan, partition by partition."""
         out: List[AttendanceRow] = []

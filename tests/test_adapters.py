@@ -279,6 +279,14 @@ class _FakeSession:
             return _FakeResultSet(
                 _Row(student_id=k[2], lecture_id=k[0], timestamp=k[1],
                      is_valid=self.rows[k]) for k in keys)
+        if q.startswith("SELECT student_id, lecture_id, timestamp, "
+                        "is_valid FROM attendance WHERE student_id = %s "
+                        "ALLOW FILTERING"):
+            (sid,) = params
+            keys = [k for k in self.rows if k[2] == int(sid)]
+            return _FakeResultSet(
+                _Row(student_id=k[2], lecture_id=k[0], timestamp=k[1],
+                     is_valid=self.rows[k]) for k in keys)
         if q == "SELECT COUNT(*) FROM attendance":
             return _FakeResultSet([_Row(count=len(self.rows))])
         if q == "TRUNCATE attendance":

@@ -171,3 +171,30 @@ def test_stats_subcommand(tmp_path, capsys):
     with np.load(tmp_path / "fused_events.npz") as d:
         exact = len(np.unique(d["student_id"][d["lecture_day"] == day]))
     assert f"{exact} unique attendees" in out
+
+
+def test_stats_student_id(tmp_path, capsys):
+    """stats --student-id answers the per-student access pattern from a
+    saved store (the README-promised events_by_student_day surface)."""
+    main(["fused", "--num-events", "8192", "--frame-size", "2048",
+          "--num-lectures", "4", "--bloom-capacity", "20000",
+          "--snapshot-dir", str(tmp_path)])
+    capsys.readouterr()
+    import json
+
+    import numpy as np
+    data = np.load(tmp_path / "fused_events.npz")
+    sid = int(np.asarray(data["student_id"])[0])
+    main(["stats", "--student-id", str(sid),
+          "--events-file", str(tmp_path / "fused_events.npz")])
+    out = capsys.readouterr().out
+    assert f"Student {sid}:" in out
+    assert "attendance records" in out
+
+
+def test_stats_requires_lecture_or_student():
+    import pytest
+
+    with pytest.raises(SystemExit) as e:
+        main(["stats"])
+    assert e.value.code == 2

@@ -146,3 +146,36 @@ def test_native_dedup_matches_numpy_lexsort():
     numpy_keep = np.sort(order[last])
     np.testing.assert_array_equal(np.asarray(native_keep, np.int64),
                                   numpy_keep)
+
+
+def test_scan_student_access_pattern():
+    """The per-student access pattern of the README-promised
+    events_by_student_day table (SURVEY §0.3 item 3), on both
+    in-process stores: every row of one student, nothing else."""
+    from attendance_tpu.storage.columnar_store import ColumnarEventStore
+    from attendance_tpu.storage.memory_store import (
+        AttendanceRow, MemoryEventStore)
+
+    def row(sid, lec, ts, valid):
+        return AttendanceRow(student_id=sid, timestamp=ts,
+                             lecture_id=lec, is_valid=valid,
+                             event_type="entry")
+
+    rows = [row(11, "LECTURE_20260101", "2026-01-01T09:00:00", True),
+            row(12, "LECTURE_20260101", "2026-01-01T09:01:00", True),
+            row(11, "LECTURE_20260102", "2026-01-02T09:00:00", False),
+            row(13, "LECTURE_20260102", "2026-01-02T09:02:00", True)]
+
+    mem = MemoryEventStore()
+    mem.insert_batch(rows)
+    got = mem.scan_student(11)
+    assert [(r.lecture_id, r.is_valid) for r in got] == [
+        ("LECTURE_20260101", True), ("LECTURE_20260102", False)]
+    assert mem.scan_student(999) == []
+
+    col = ColumnarEventStore()
+    col.insert_batch(rows)
+    cols = col.scan_student(11)
+    assert sorted(cols["lecture_day"].tolist()) == [20260101, 20260102]
+    assert len(cols["student_id"]) == 2
+    assert len(col.scan_student(999)["student_id"]) == 0
