@@ -116,16 +116,19 @@ def cmd_stats(args) -> None:
     from attendance_tpu.storage import make_event_store
 
     config = config_from_args(args)
+    if args.student_id is None and not args.lecture_id:
+        # Validate the query shape BEFORE touching any backend: a
+        # missing argument must not first open a Cassandra/Redis
+        # connection just to fail confusingly.
+        import sys
+
+        logger.error("stats needs a lecture_id or --student-id")
+        sys.exit(2)
     sketch = make_sketch_store(config)
     if args.events_file:
         store = _store_for_events_file(config, args.events_file)
     else:
         store = make_event_store(config)
-    if args.student_id is None and not args.lecture_id:
-        import sys
-
-        logger.error("stats needs a lecture_id or --student-id")
-        sys.exit(2)
     if args.student_id is not None:
         records = store.scan_student(args.student_id)
         if isinstance(records, dict):

@@ -137,6 +137,12 @@ class Config:
             raise ValueError(f"unknown replica sync: {self.replica_sync}")
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
+        if self.invalid_topic and self.invalid_topic == self.pulsar_topic:
+            # Republishing invalid events onto the processor's own
+            # input topic would re-consume and republish them forever.
+            raise ValueError(
+                "invalid_topic must differ from pulsar_topic (equal "
+                "topics make an unbounded reprocessing loop)")
         return self
 
 

@@ -294,6 +294,11 @@ class AttendanceProcessor:
         #    computed-invalid event republished on the side topic, in
         #    the reference's own JSON wire format. Off the main
         #    contract (storage keeps the is_valid=false row either way).
+        #    Delivery is AT-LEAST-ONCE like every other sink: a batch
+        #    nacked after this point republishes its invalid events on
+        #    redelivery, so side-topic consumers dedup by the event's
+        #    (lecture_id, timestamp, student_id) key — the same
+        #    idempotency rule the main store applies.
         if self._invalid_producer is not None:
             from attendance_tpu.pipeline.events import encode_event
             for e, v in zip(events, is_valid):

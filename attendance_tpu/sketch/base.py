@@ -256,35 +256,44 @@ class SketchStore(abc.ABC):
         """The exact call shape the reference uses for BF.* commands.
 
         Arity mistakes raise :class:`ResponseError` like a real server
-        ("wrong number of arguments"), not a bare unpacking ValueError —
-        callers written against redis-py catch exactly one type.
+        ("wrong number of arguments"), not a bare unpacking ValueError
+        or IndexError — callers written against redis-py catch exactly
+        one type for command-shape errors. The check is explicit per
+        command (no blanket exception conversion: a genuine backend bug
+        must never be mislabelled as a caller arity mistake).
         """
         if not args:
             raise ResponseError("empty command")
         cmd = str(args[0]).upper()
-        try:
-            return self._dispatch_command(cmd, args)
-        except (ValueError, TypeError) as e:
-            raise ResponseError(
-                f"wrong number of arguments for {cmd!r}") from e
+        n = len(args) - 1
 
-    def _dispatch_command(self, cmd: str, args):
+        def need(lo: int, hi: Optional[float] = None) -> None:
+            """hi=None means exactly ``lo`` args; pass float('inf')
+            for variadic commands."""
+            top = lo if hi is None else hi
+            if n < lo or n > top:
+                raise ResponseError(
+                    f"wrong number of arguments for {cmd!r}")
+
         if cmd == "BF.RESERVE":
-            _, key, error_rate, capacity = args
-            return self.bf_reserve(str(key), error_rate, capacity)
+            need(3)
+            return self.bf_reserve(str(args[1]), args[2], args[3])
         if cmd == "BF.ADD":
-            _, key, member = args
-            return int(self.bf_add_many(str(key), [member])[0])
+            need(2)
+            return int(self.bf_add_many(str(args[1]), [args[2]])[0])
         if cmd == "BF.MADD":
+            need(2, float("inf"))
             key = str(args[1])
             return [int(x) for x in self.bf_add_many(key, list(args[2:]))]
         if cmd == "BF.EXISTS":
-            _, key, member = args
-            return int(self.bf_exists_many(str(key), [member])[0])
+            need(2)
+            return int(self.bf_exists_many(str(args[1]), [args[2]])[0])
         if cmd == "BF.MEXISTS":
+            need(2, float("inf"))
             key = str(args[1])
             return [int(x) for x in self.bf_exists_many(key, list(args[2:]))]
         if cmd == "BF.INFO":
+            need(1)
             key = str(args[1])
             bloom = self._blooms.get(key)
             if bloom is None:
@@ -297,8 +306,10 @@ class SketchStore(abc.ABC):
                 "Expansion rate": EXPANSION,
             }
         if cmd == "PFADD":
+            need(1, float("inf"))
             return self.pfadd(str(args[1]), *args[2:])
         if cmd == "PFCOUNT":
+            need(1, float("inf"))
             return self.pfcount(*[str(k) for k in args[1:]])
         raise ResponseError(f"unknown command {cmd!r}")
 

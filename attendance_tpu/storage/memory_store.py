@@ -73,11 +73,7 @@ class MemoryEventStore:
         filtered scan over the one real table, like the analyzer's own
         ALLOW FILTERING reads."""
         sid = int(student_id)
-        out: List[AttendanceRow] = []
-        for lecture_id in self.distinct_lecture_ids():
-            out.extend(r for r in self.scan_lecture(lecture_id)
-                       if r.student_id == sid)
-        return out
+        return [r for r in self.scan_all() if r.student_id == sid]
 
     def scan_all(self) -> List[AttendanceRow]:
         """Full-table scan, partition by partition."""

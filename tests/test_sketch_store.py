@@ -140,3 +140,34 @@ def test_execute_command_arity_errors_are_response_errors():
             store.execute_command("BF.EXISTS", "k", "a", "b")  # extra
         with pytest.raises(ResponseError):
             store.execute_command("NOT.A.COMMAND", "k")
+
+
+def test_execute_command_missing_key_arity_is_response_error():
+    """Arity mistakes where even the KEY is missing (args[1] would
+    IndexError) must also surface as ResponseError — the conversion is
+    explicit per command, not a blanket exception rewrite."""
+    import pytest
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.sketch.base import ResponseError
+    from attendance_tpu.sketch.memory_store import MemorySketchStore
+
+    store = MemorySketchStore(Config(sketch_backend="memory"))
+    for cmd in ("PFADD", "PFCOUNT", "BF.INFO", "BF.MADD", "BF.MEXISTS",
+                "BF.ADD", "BF.EXISTS", "BF.RESERVE"):
+        with pytest.raises(ResponseError):
+            store.execute_command(cmd)
+    # Correct-arity bad VALUES are not mislabelled as arity errors.
+    with pytest.raises(Exception) as e:
+        store.execute_command("BF.RESERVE", "k", "not-a-rate", 100)
+    assert "wrong number of arguments" not in str(e.value)
+
+
+def test_invalid_topic_must_differ_from_input_topic():
+    import pytest
+
+    from attendance_tpu.config import Config
+
+    with pytest.raises(ValueError, match="invalid_topic"):
+        Config(invalid_topic=Config().pulsar_topic).validate()
+    Config(invalid_topic="attendance-invalid").validate()  # fine
