@@ -171,3 +171,82 @@ def test_invalid_topic_must_differ_from_input_topic():
     with pytest.raises(ValueError, match="invalid_topic"):
         Config(invalid_topic=Config().pulsar_topic).validate()
     Config(invalid_topic="attendance-invalid").validate()  # fine
+
+
+def test_randomized_command_sequences_hold_invariants():
+    """Generative differential check: random BF./PF. command sequences
+    driven through every hermetic backend against an exact-set oracle.
+    Invariants per backend: BF.EXISTS never false-negative on an added
+    member; PFCOUNT within the sketch budget of the exact distinct
+    count; PFADD return semantics (1 on first-ever member via the
+    scalar path). Backends may disagree on individual false positives
+    (different hash families) — that is the documented contract."""
+    import numpy as np
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.sketch.memory_store import MemorySketchStore
+    from attendance_tpu.sketch.redis_sim import RedisSimSketchStore
+    from attendance_tpu.sketch.tpu_store import TpuSketchStore
+
+    rng = np.random.default_rng(77)
+    stores = {
+        "memory": MemorySketchStore(Config(sketch_backend="memory")),
+        "redis-sim": RedisSimSketchStore(Config(sketch_backend="redis-sim")),
+        "tpu": TpuSketchStore(Config(sketch_backend="tpu")),
+    }
+    bloom_truth: dict = {}   # key -> set of added members
+    hll_truth: dict = {}     # key -> set of counted members
+
+    for _step in range(60):
+        op = rng.choice(["reserve", "add", "madd", "exists", "mexists",
+                         "pfadd", "pfadd_many", "pfcount"])
+        key = f"k{rng.integers(0, 4)}"
+        members = rng.integers(1, 50_000, rng.integers(1, 40)).tolist()
+        if op == "reserve":
+            for name, s in stores.items():
+                try:
+                    s.execute_command("BF.RESERVE", key, 0.01, 2_000)
+                    created = True
+                except Exception:
+                    created = False
+                # Reserve outcome must agree across backends.
+                assert created == (key not in bloom_truth) \
+                    or key in bloom_truth, name
+            bloom_truth.setdefault(key, set())
+        elif op in ("add", "madd"):
+            bloom_truth.setdefault(key, set()).update(members)
+            for s in stores.values():
+                if op == "add":
+                    s.execute_command("BF.ADD", key, members[0])
+                    s.bf_add_many(key, np.array(members[1:], np.int64)) \
+                        if len(members) > 1 else None
+                else:
+                    s.execute_command("BF.MADD", key, *members)
+        elif op in ("exists", "mexists"):
+            added = bloom_truth.get(key, set())
+            probe = members + list(added)[:20]
+            for name, s in stores.items():
+                got = s.bf_exists_many(key, np.array(probe, np.int64))
+                for m, g in zip(probe, got):
+                    if m in added:
+                        assert g, (name, key, m)  # no false negatives
+        elif op == "pfadd":
+            first = members[0] not in hll_truth.setdefault(key, set())
+            hll_truth[key].add(members[0])
+            for name, s in stores.items():
+                changed = s.execute_command("PFADD", key, members[0])
+                if first:
+                    assert changed == 1, (name, key, members[0])
+        elif op == "pfadd_many":
+            hll_truth.setdefault(key, set()).update(members)
+            for s in stores.values():
+                s.pfadd_many(key, np.array(members, np.int64))
+        else:  # pfcount
+            exact = len(hll_truth.get(key, set()))
+            for name, s in stores.items():
+                est = s.execute_command("PFCOUNT", key)
+                if exact == 0:
+                    assert est == 0, name
+                else:
+                    assert abs(est - exact) <= max(3, 0.05 * exact), \
+                        (name, key, est, exact)
