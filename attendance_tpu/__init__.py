@@ -38,7 +38,10 @@ _EXPORTS = {
     "make_client": "attendance_tpu.transport",
     "ShardedSketchEngine": "attendance_tpu.parallel.sharded",
     "run_parity": "attendance_tpu.parity",
+    "run_sim_parity": "attendance_tpu.parity",
     "run_redis_parity": "attendance_tpu.parity",
+    "JsonBinaryBridge": "attendance_tpu.pipeline.bridge",
+    "RedisSimSketchStore": "attendance_tpu.sketch.redis_sim",
 }
 
 
