@@ -153,14 +153,6 @@ class FusedPipeline:
                 layout="blocked",
                 replica_sync=self.config.replica_sync)
             self.params = self.engine.params
-            # Monotonic key-width hint for the mesh word wire (same
-            # compile-churn bound as the single-chip _pick_kw path),
-            # plus the delta-width hint/decay state the mesh seg/delta
-            # wires share with the single-chip ladder.
-            self._kw_hint = 1
-            self._db_hint = 1
-            self._db_slack = 0
-            self._db_seen = 1
         else:
             self.engine = None
             self.state, self.params = init_state(
@@ -189,27 +181,6 @@ class FusedPipeline:
             # to even values — a stable population compiles a couple of
             # programs, not one per frame.
             self._delta_steps: Dict[tuple, object] = {}
-            self._db_hint = 1
-            # Decay bookkeeping: frames whose own needed width sits
-            # well under the hint, and the widest such width seen. A
-            # transient outlier frame must not pin the delta wire wide
-            # forever (every extra bit is link bytes).
-            self._db_slack = 0
-            self._db_seen = 1
-            self._kw_hint = 1
-            # Adaptive wire ladder for auto mode (see _auto_wire):
-            # 0 = word (cheapest host pack), 1 = seg, 2 = delta
-            # (narrowest link). Which resource binds depends on the
-            # moment's link rate vs host contention, so auto adapts
-            # per frame from observed backpressure instead of
-            # committing to either.
-            self._auto_level = 0
-            self._auto_pressure = 0
-            self._drain_waited = False
-            # One-time notice when a FORCED word wire cannot be honored
-            # (key+bank bits exceed a word) and frames degrade to the
-            # bytes wire — without it only wire_dwell reveals the switch.
-            self._warned_word_degrade = False
             # Native host runtime (fused decode+LUT+pack pass); None
             # falls back to the numpy path transparently. _native_skip
             # adaptively bypasses doomed native attempts when the
@@ -222,6 +193,26 @@ class FusedPipeline:
                 lambda bits, keys: bloom_add_packed(bits, keys,
                                                     self.params),
                 donate_argnums=(0,))
+        # Wire-selection state shared by BOTH engines (the mesh rides
+        # the same ladder and width hints as the single chip):
+        # monotonic key-width hint (bounds compile churn), delta-width
+        # hint with outlier decay (every extra bit is link bytes), and
+        # the adaptive ladder for auto mode (see _auto_wire):
+        # 0 = word (cheapest host pack), 1 = seg, 2 = delta (narrowest
+        # link). Which resource binds depends on the moment's link
+        # rate vs host contention, so auto adapts per frame from
+        # observed backpressure instead of committing to either.
+        self._kw_hint = 1
+        self._db_hint = 1
+        self._db_slack = 0
+        self._db_seen = 1
+        self._auto_level = 0
+        self._auto_pressure = 0
+        self._drain_waited = False
+        # One-time notice when a FORCED word wire cannot be honored
+        # (key+bank bits exceed a word) and frames degrade to the
+        # bytes wire — without it only wire_dwell reveals the switch.
+        self._warned_word_degrade = False
         self._profiling = bool(self.config.profile_dir)
         self._bank_of: Dict[int, int] = {}
         # Dense day->bank lookup: maps days in [base, base + LUT) with one
@@ -360,11 +351,19 @@ class FusedPipeline:
             sid = cols["student_id"]
             banks = self._banks_for(cols["lecture_day"])
             num_banks = self.engine.num_banks
-            if self.config.wire_format in ("seg", "delta"):
+            wire = self.config.wire_format
+            if wire == "auto":
+                # Same adaptive ladder as the single-chip path: the
+                # backpressure signal (hot loop blocked on a full
+                # in-flight deque) is wire-agnostic, and the mesh's
+                # narrow wires trade host pack time for link bytes
+                # exactly like the single-chip ones.
+                wire = self._auto_wire()
+            if wire in ("seg", "delta"):
                 with maybe_annotate(self._profiling,
                                     "sharded_narrow_step"):
                     valid_n, lanes, orig = self._dispatch_sharded_narrow(
-                        sid, banks, n, self.config.wire_format)
+                        sid, banks, n, wire)
                 # valid_n is in packed per-slice order; the lazy view
                 # restores original order at read time (same contract
                 # as the single-chip narrow wires below).

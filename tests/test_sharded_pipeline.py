@@ -209,3 +209,44 @@ def test_sharded_validity_counts_and_snapshot_counts(tmp_path):
     pipe3 = FusedPipeline(cfg3, client=MemoryClient(MemoryBroker()),
                           num_banks=8)
     assert pipe3.validity_counts() == vc
+
+
+def test_sharded_auto_ladder_dispatches_narrow_under_pressure():
+    """The adaptive wire ladder now drives the mesh too: at ladder
+    level 1/2 (sustained link backpressure) auto mode dispatches the
+    seg/delta wires, with results identical to the word wire."""
+    num_events, batch = 4_096, 1_024
+    roster, frames = generate_frames(num_events, batch, roster_size=4_000,
+                                     num_lectures=4, seed=47)
+    frames = list(frames)
+    config = Config(bloom_filter_capacity=10_000,
+                    transport_backend="memory",
+                    num_shards=2, num_replicas=2, wire_format="auto")
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe._auto_level = 1  # as if the climb signal fired
+    pipe._auto_pressure = 0
+    pipe.run(max_events=num_events, idle_timeout_s=0.4)
+    assert pipe.metrics.wire_dwell.get("seg", 0) > 0
+    vc = pipe.validity_counts()
+    assert sum(vc) == num_events
+
+    # Reference answer on the default (word) wire.
+    client2 = MemoryClient(MemoryBroker())
+    ref = FusedPipeline(config, client=client2, num_banks=8)
+    ref.preload(roster)
+    prod2 = client2.create_producer(config.pulsar_topic)
+    for f in frames:
+        prod2.send(f)
+    ref.run(max_events=num_events, idle_timeout_s=0.4)
+    assert ref.validity_counts() == vc
+    df_a = pipe.store.to_dataframe(deduplicate=False).sort_values(
+        ["micros", "student_id"])
+    df_b = ref.store.to_dataframe(deduplicate=False).sort_values(
+        ["micros", "student_id"])
+    np.testing.assert_array_equal(df_a.is_valid.to_numpy(bool),
+                                  df_b.is_valid.to_numpy(bool))
