@@ -105,8 +105,10 @@ class Config:
     # depending on the moment's link rate vs host load; "auto" starts
     # at the cheap word wire and adapts per frame from observed
     # backpressure (narrowing word->seg->delta when the device side
-    # falls behind — see fast_path._auto_wire; requires the native
-    # host runtime to narrow). "delta"/"seg"/"word"/"bytes" force one.
+    # falls behind — see fast_path._auto_wire). On the single chip the
+    # narrow packs need the native host runtime (auto stays on word
+    # without it); the mesh path packs per-replica buffers in numpy and
+    # narrows either way. "delta"/"seg"/"word"/"bytes" force one.
     wire_format: str = "auto"
     # Optional side topic for computed-invalid events ("" = disabled).
     # The reference's README promises an "attendance-invalid" routing

@@ -381,6 +381,7 @@ class FusedPipeline:
                         valid_n = self.engine.step_words(words, n, kw)
                     else:
                         # Separate key/bank/mask arrays (9 B/event).
+                        self._note_word_degrade()
                         self._count_wire("arrays")
                         valid_n = self.engine.step(sid, banks)
                 stored = valid_n
@@ -677,16 +678,18 @@ class FusedPipeline:
 
     def _note_word_degrade(self) -> None:
         """Log ONCE when ``--wire-format=word`` was requested but a
-        frame's key + bank bits exceed 32 and it must ride the bytes
-        wire instead — a forced format is otherwise silently unhonored
-        (only wire_dwell would reveal it)."""
+        frame's key + bank bits exceed 32 and it must ride the wide
+        fallback wire instead (bytes single-chip, arrays on the mesh) —
+        a forced format is otherwise silently unhonored (only
+        wire_dwell would reveal it)."""
         if (self.config.wire_format == "word"
                 and not self._warned_word_degrade):
             self._warned_word_degrade = True
             logger.warning(
                 "--wire-format=word cannot be honored: key bits + bank "
                 "bits exceed one 32-bit word; frames fall back to the "
-                "bytes wire (see metrics wire_dwell for the split)")
+                "%s wire (see metrics wire_dwell for the split)",
+                "arrays" if self.sharded else "bytes")
 
     _WIRE_LADDER = ("word", "seg", "delta")
 
