@@ -124,12 +124,21 @@ def cmd_stats(args) -> None:
 
         logger.error("stats needs a lecture_id or --student-id")
         sys.exit(2)
-    sketch = make_sketch_store(config)
     if args.events_file:
         store = _store_for_events_file(config, args.events_file)
     else:
         store = make_event_store(config)
     if args.student_id is not None:
+        # The per-student scan never consults the sketch backend, so
+        # it is not opened here (same validate-before-connect intent
+        # as the arg check above — a Redis/TPU init for a query that
+        # ignores it is pure cost). A lecture_id alongside
+        # --student-id would be silently ignored; say so.
+        if args.lecture_id:
+            logger.warning(
+                "--student-id given: lecture_id %r is ignored "
+                "(per-student scan spans all lectures)",
+                args.lecture_id)
         records = store.scan_student(args.student_id)
         if isinstance(records, dict):
             lectures = sorted(set(records["lecture_day"].tolist()))
@@ -141,6 +150,7 @@ def cmd_stats(args) -> None:
         print(f"Student {args.student_id}: {n} attendance records "
               f"({nv} valid) across {len(lectures)} lectures")
         return
+    sketch = make_sketch_store(config)
     unique = sketch.pfcount(
         f"{config.hll_key_prefix}{args.lecture_id}")
     records = store.scan_lecture(args.lecture_id)

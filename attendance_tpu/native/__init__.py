@@ -181,17 +181,16 @@ class HostPipe:
             return None, None, -2
         return None, None, int(rc - 1)
 
-    def pack_delta(self, keys: np.ndarray, days: np.ndarray,
-                   lut: np.ndarray, day_base: int, db_hint: int,
-                   padded: int, num_banks: int):
-        """Fused LUT map + (bank, key) sort + delta emit + bit-pack
-        (models.fused delta wire). Returns (buf, perm, db, needed, -1)
-        on success — db is the packed width (>= db_hint, rounded even)
-        and needed the frame's own minimum, which callers use to decay
-        a stale-high hint — or (None, None, 0, 0, miss_index) on a LUT
-        miss / (None, None, 0, 0, -2) when the native pass can't run."""
-        from attendance_tpu.models.fused import delta_buf_words
-
+    def delta_scan(self, keys: np.ndarray, days: np.ndarray,
+                   lut: np.ndarray, day_base: int, num_banks: int):
+        """Fused LUT map + (bank, key) sort + delta emit — the scan
+        half of the delta wire, without the bit-pack. Returns
+        (scan, -1) where ``scan`` is the models.fused.delta_scan tuple
+        (perm, counts, bases, deltas, needed) — interchangeable with
+        the numpy scan, which is what lets the sharded per-replica
+        packs pick ONE shared width across natively- and numpy-scanned
+        slices — or (None, miss_index) on a LUT miss /
+        (None, -2) when the native pass can't run."""
         kp, ks = self._strided(keys)
         dp, ds = self._strided(days)
         n = len(keys)
@@ -206,12 +205,26 @@ class HostPipe:
             _ptr(counts, _u32p), _ptr(bases, _u32p), _ptr(deltas, _u32p),
             _ptr(perm, _u32p), _ptr(needed, _u32p))
         if rc > 0:
-            return None, None, 0, 0, int(rc - 1)
+            return None, int(rc - 1)
         if rc < 0:
-            return None, None, 0, 0, -2
-        from attendance_tpu.models.fused import pick_delta_width
+            return None, -2
+        return ((perm[:n], counts, bases, deltas[:n],
+                 max(int(needed[0]), 1)), -1)
 
-        db = pick_delta_width(db_hint, int(needed[0]))
+    def bitpack_delta(self, scan, db: int, padded: int,
+                      num_banks: int) -> Optional[np.ndarray]:
+        """Bit-pack a delta scan (native or numpy tuple) at width
+        ``db`` into the wire buffer fused_step_delta consumes; None
+        when the width is too narrow for the scan's widest gap or the
+        native pass can't run (callers fall back to the numpy
+        models.fused.pack_delta with the same scan)."""
+        from attendance_tpu.models.fused import delta_buf_words
+
+        perm, counts, bases, deltas, needed = scan
+        if needed > db:
+            return None
+        n = len(perm)
+        deltas = np.ascontiguousarray(deltas, dtype=np.uint32)
         buf = np.empty(delta_buf_words(num_banks, db, padded), np.uint32)
         buf[:num_banks] = counts
         buf[num_banks:2 * num_banks] = bases
@@ -220,8 +233,29 @@ class HostPipe:
             _ptr(buf[2 * num_banks:], _u32p),
             len(buf) - 2 * num_banks)
         if rc < 0:
+            return None
+        return buf
+
+    def pack_delta(self, keys: np.ndarray, days: np.ndarray,
+                   lut: np.ndarray, day_base: int, db_hint: int,
+                   padded: int, num_banks: int):
+        """Fused LUT map + (bank, key) sort + delta emit + bit-pack
+        (models.fused delta wire). Returns (buf, perm, db, needed, -1)
+        on success — db is the packed width (>= db_hint, rounded even)
+        and needed the frame's own minimum, which callers use to decay
+        a stale-high hint — or (None, None, 0, 0, miss_index) on a LUT
+        miss / (None, None, 0, 0, -2) when the native pass can't run."""
+        scan, miss = self.delta_scan(keys, days, lut, day_base,
+                                     num_banks)
+        if scan is None:
+            return None, None, 0, 0, miss
+        from attendance_tpu.models.fused import pick_delta_width
+
+        db = pick_delta_width(db_hint, scan[4])
+        buf = self.bitpack_delta(scan, db, padded, num_banks)
+        if buf is None:
             return None, None, 0, 0, -2
-        return buf, perm[:n], db, int(needed[0]), -1
+        return buf, scan[0], db, scan[4], -1
 
     def prepare_json_batch(self, payloads) -> "PreparedJsonBatch":
         """One-time O(total bytes) setup for a batch of JSON payloads;

@@ -266,7 +266,20 @@ class _SimChain:
         first just set: BF.MADD k 7 7 answers [1, 0]. Mirror that — only
         the FIRST occurrence of each distinct new member reports added,
         and capacity accounting counts distinct members once, even
-        across chunk/grow boundaries.
+        across chunk/grow boundaries. New members are inserted in CALL
+        order so grow boundaries split the call exactly where a real
+        server would.
+
+        Known deviation (the cost of the vectorized membership check):
+        a real server's later members also see bits set by earlier
+        DISTINCT members of the same call, so an intra-call false
+        positive suppresses that member's insertion ("already present")
+        — here membership is evaluated once against the pre-call state,
+        so such a member is still inserted and reported added. The
+        divergence needs an FP between two members of one call
+        (probability ~ eps per member) and only perturbs which exact
+        bits/counters a scaling chain carries, never membership
+        answers.
         """
         existed = self.contains_many(keys_u32)
         added = np.zeros(len(keys_u32), dtype=np.int64)
@@ -275,6 +288,13 @@ class _SimChain:
             return added
         uniq, first = np.unique(keys_u32[new_idx], return_index=True)
         added[new_idx[first]] = 1
+        # Insert in CALL order, not np.unique's sorted order: when one
+        # BF.MADD crosses a grow boundary, which keys land in the old
+        # vs the new sub-filter must match a real server's sequential
+        # processing (bit-state fidelity for the live-Redis parity
+        # gate; membership answers are unaffected either way).
+        order = np.argsort(first, kind="stable")
+        uniq = uniq[order]
         i = 0
         while i < len(uniq):
             room = self.params[-1].capacity - self.counts[-1]

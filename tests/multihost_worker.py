@@ -49,6 +49,7 @@ def run_workload(mesh) -> dict:
     nvalid_total = 0
     total = 0
     exact = [set() for _ in range(8)]
+    vhash = hashlib.sha256()
     for step_i in range(4):
         n = 4096
         take = rng.random(n) < 0.85
@@ -64,9 +65,15 @@ def run_workload(mesh) -> dict:
             words = np.full(padded, 0xFFFFFFFF, np.uint32)
             words[:n] = (banks.astype(np.uint32) << kw) | keys
             valid = engine.step_words(words, n, kw)
-        # Device-side reduction: the validity vector is dp-sharded
-        # across processes, so only collectively-reduced scalars (and
-        # fully-replicated outputs) are host-readable.
+        # On a multi-process mesh the step kernels all_gather the
+        # validity across "dp" (sharded.py host_readable), so the raw
+        # vector is directly host-materializable here — the store-write
+        # path FusedPipeline depends on. Hash it so the test proves the
+        # per-event bits (not just the total) are identical to the
+        # single-process execution.
+        v_host = np.asarray(valid)
+        assert v_host.shape == (n,), v_host.shape
+        vhash.update(np.packbits(v_host).tobytes())
         nvalid_total += int(jax.jit(lambda v: jnp.sum(v.astype(jnp.int32))
                                     )(valid))
         total += n
@@ -90,6 +97,7 @@ def run_workload(mesh) -> dict:
         "member_invalid": int(member[512:].sum()),
         "bloom_sha": hashlib.sha256(bits.tobytes()).hexdigest(),
         "regs_sha": hashlib.sha256(regs.tobytes()).hexdigest(),
+        "valid_sha": vhash.hexdigest(),
     }
 
 

@@ -99,7 +99,7 @@ def test_two_process_dcn_cluster_matches_single_process(tmp_path):
     for r in results:
         for key in ("nvalid_total", "total", "counts", "exact",
                     "member_roster", "member_invalid", "bloom_sha",
-                    "regs_sha"):
+                    "regs_sha", "valid_sha"):
             assert r[key] == ref[key], (key, r[key], ref[key])
 
     # Sanity on the shared answer itself: complete roster membership

@@ -94,6 +94,54 @@ def test_strided_atb1_record_input(hp):
     assert np.array_equal(words, pack_words(keys, banks, 22, 1 << 14))
 
 
+def test_delta_scan_matches_numpy(hp):
+    """The split scan half of the native delta pack returns the numpy
+    models.fused.delta_scan tuple exactly — the interchangeability the
+    sharded per-replica packs rely on to share one width across
+    natively- and numpy-scanned slices."""
+    from attendance_tpu.models.fused import delta_scan
+
+    keys, days, lut, base = _fixture(n=20_000)
+    num_banks = 64
+    scan, miss = hp.delta_scan(keys, days, lut, base, num_banks)
+    assert miss == -1
+    perm_n, counts_n, bases_n, deltas_n, needed_n = scan
+    banks = lut[days - base]
+    perm, counts, bases, deltas, needed = delta_scan(keys, banks,
+                                                     num_banks)
+    np.testing.assert_array_equal(perm_n, perm)
+    np.testing.assert_array_equal(counts_n, counts)
+    np.testing.assert_array_equal(bases_n, bases)
+    np.testing.assert_array_equal(deltas_n, deltas)
+    assert needed_n == needed
+
+
+def test_bitpack_delta_interchangeable_with_numpy(hp):
+    """bitpack_delta over a native OR a numpy scan produces the exact
+    buffer numpy pack_delta builds (and refuses a too-narrow width the
+    same way)."""
+    from attendance_tpu.models.fused import (
+        delta_scan, pack_delta, pick_delta_width)
+
+    keys, days, lut, base = _fixture(n=20_000)
+    num_banks, padded = 64, 1 << 15
+    banks = lut[days - base]
+    scan_np = delta_scan(keys, banks, num_banks)
+    scan_nat, miss = hp.delta_scan(keys, days, lut, base, num_banks)
+    assert miss == -1
+    db = pick_delta_width(1, scan_np[-1])
+    buf_ref, _ = pack_delta(keys, banks, db, padded, num_banks,
+                            scan=scan_np)
+    for scan in (scan_np, scan_nat):
+        buf = hp.bitpack_delta(scan, db, padded, num_banks)
+        np.testing.assert_array_equal(buf, buf_ref)
+    # Too-narrow width: same refusal contract as numpy pack_delta.
+    assert hp.bitpack_delta(scan_nat, scan_nat[-1] - 1, padded,
+                            num_banks) is None
+    assert pack_delta(keys, banks, scan_np[-1] - 1, padded, num_banks,
+                      scan=scan_np) == (None, None)
+
+
 def test_word_step_matches_byte_step():
     """fused_step_words == fused_step_bytes on identical inputs (the two
     wire formats must be semantically interchangeable)."""

@@ -148,6 +148,37 @@ class TestSimBloom:
         assert not store.bf_exists_many(
             "nope", np.arange(10, dtype=np.uint32)).any()
 
+    def test_madd_crossing_grow_boundary_inserts_in_call_order(self):
+        """A real server processes BF.MADD members sequentially, so
+        when one call crosses a sub-filter grow boundary, which keys
+        land in the old vs the new sub-filter follows CALL order — one
+        bulk MADD must leave the chain bit-identical to the same keys
+        added one at a time (ADVICE r03: np.unique's sorted order
+        diverged here)."""
+        rng = np.random.default_rng(5)
+        keys = rng.permutation(
+            np.arange(1, 301, dtype=np.uint32))  # shuffled, not sorted
+
+        # eps=1e-4 keeps intra-call false positives improbable: the one
+        # sequential-processing effect add_many deliberately does NOT
+        # mirror (documented in its docstring) is a later member
+        # colliding with bits set earlier in the same call, and at the
+        # default 0.01 that confounds the order property under test.
+        bulk = _sim()
+        bulk.bf_reserve("bf", 0.0001, 200)  # 300 keys -> grows mid-call
+        bulk.bf_add_many("bf", keys)
+
+        seq = _sim()
+        seq.bf_reserve("bf", 0.0001, 200)
+        for k in keys:
+            seq.bf_add_many("bf", np.array([k], np.uint32))
+
+        cb, cs = bulk._blooms["bf"], seq._blooms["bf"]
+        assert len(cb.filters) == len(cs.filters) > 1
+        assert cb.counts == cs.counts
+        for fb, fs in zip(cb.filters, cs.filters):
+            np.testing.assert_array_equal(fb, fs)
+
 
 # ---------------------------------------------------------------------------
 # Redis dense HLL semantics
