@@ -57,10 +57,18 @@ class Config:
     # arrays + jitted kernels; "memory" = pure-python host sketches (hermetic
     # tests, no JAX); "redis" = real Redis Stack (import-gated).
     sketch_backend: str = "tpu"
-    # Transport/storage backends: "memory" (hermetic, in-process) or the
-    # real services ("pulsar"/"cassandra", import-gated).
+    # Transport/storage backends: "memory" (hermetic, in-process),
+    # "socket" (the framework's own cross-process broker,
+    # transport.socket_broker — multi-process competing consumers
+    # without an external service), or the real services
+    # ("pulsar"/"cassandra", import-gated).
     transport_backend: str = "memory"
     storage_backend: str = "memory"
+    # Address of a running BrokerServer for --transport-backend=socket
+    # (start one with: python -m attendance_tpu.transport.socket_broker).
+    # Port matches transport.socket_broker.DEFAULT_PORT so the no-flag
+    # broker recipe and this default reach each other out of the box.
+    socket_broker: str = "127.0.0.1:6655"
     # Micro-batch size for the processor hot loop. Events are padded to this
     # size so every device dispatch has a static shape (XLA: one compile).
     batch_size: int = 8192
@@ -162,8 +170,14 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    help="execution backend for BF.*/PFADD/PFCOUNT "
                    "(redis-sim = hermetic simulation of Redis's "
                    "algorithms, the server-free parity oracle)")
-    p.add_argument("--transport-backend", choices=["memory", "pulsar"],
-                   default=d.transport_backend)
+    p.add_argument("--transport-backend",
+                   choices=["memory", "socket", "pulsar"],
+                   default=d.transport_backend,
+                   help="socket = the framework's own cross-process "
+                   "broker (transport.socket_broker)")
+    p.add_argument("--socket-broker", default=d.socket_broker,
+                   help="BrokerServer address for "
+                   "--transport-backend=socket")
     p.add_argument("--storage-backend",
                    choices=["memory", "columnar", "cassandra"],
                    default=d.storage_backend)
@@ -224,6 +238,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         sketch_backend=args.sketch_backend,
         transport_backend=args.transport_backend,
         storage_backend=args.storage_backend,
+        socket_broker=args.socket_broker,
         batch_size=args.batch_size,
         batch_timeout_s=args.batch_timeout_s,
         bloom_layout=args.bloom_layout,

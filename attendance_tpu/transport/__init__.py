@@ -12,6 +12,11 @@ those call shapes API-stable across two backends selected by
   * "memory" — hermetic in-process broker with the same delivery
                semantics: shared-subscription competing consumers,
                per-message ack, nack->redelivery, at-least-once.
+  * "socket" — the memory broker behind a TCP front
+               (transport.socket_broker): the same semantics across
+               PROCESSES, including crash takeover on connection drop —
+               the framework-native stand-in for the Pulsar service's
+               multi-process scale-out role.
   * "pulsar" — the real broker via pulsar-client (import-gated).
 """
 
@@ -104,6 +109,9 @@ def make_client(config):
     """Build the transport client selected by config.transport_backend."""
     if config.transport_backend == "memory":
         return MemoryClient(MemoryBroker.shared())
+    if config.transport_backend == "socket":
+        from attendance_tpu.transport.socket_broker import SocketClient
+        return SocketClient(config.socket_broker)
     if config.transport_backend == "pulsar":
         from attendance_tpu.transport.pulsar_client import PulsarClient
         return PulsarClient(config.pulsar_host)

@@ -1,0 +1,414 @@
+"""Cross-process broker transport: the memory broker behind a TCP front.
+
+The reference gets multi-process scale-out from an external Pulsar
+service: N processor processes join one Shared subscription and receive
+disjoint messages (reference attendance_processor.py:30-34). This module
+is the framework-native equivalent for environments without a broker
+service: a :class:`BrokerServer` hosts a :class:`MemoryBroker` (same
+delivery semantics: shared subscriptions, ack/nack, redelivery, crash
+takeover) behind a length-prefixed TCP protocol, and :class:`SocketClient`
+speaks the same producer/consumer call shape as MemoryClient — so every
+existing consumer (processor, bridge, fused pipeline) scales across
+PROCESSES by pointing at a broker address instead of an in-process object.
+
+Crash takeover works across processes: when a client connection drops
+(crash, kill), the server closes that connection's consumers, requeueing
+their unacked messages for the surviving competitors — the Pulsar
+behavior the reference relies on for fault tolerance (SURVEY.md §5).
+
+Protocol (little-endian): request = u8 opcode, u32 body_len, body;
+reply = u8 status (0 ok / 1 timeout / 2 error), u32 body_len, body.
+One in-flight request per connection (synchronous RPC); batch receives
+amortize the round-trip exactly like the in-process batch lanes.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+from attendance_tpu.transport.memory_broker import (
+    MemoryBroker, Message, ReceiveTimeout)
+
+logger = logging.getLogger(__name__)
+
+_OP_PRODUCE = 1
+_OP_SUBSCRIBE = 2
+_OP_RECEIVE = 3
+_OP_ACK_IDS = 4
+_OP_NACK = 5
+_OP_BACKLOG = 6
+_OP_CLOSE_CONSUMER = 7
+
+_ST_OK = 0
+_ST_TIMEOUT = 1
+_ST_ERROR = 2
+
+# Default port of the standalone broker (python -m ...socket_broker) and
+# of Config.socket_broker — one constant so the out-of-box recipe works.
+DEFAULT_PORT = 6655
+
+_HDR = struct.Struct("<BI")
+# Server-side cap on one blocking wait; a client "no timeout" receive
+# loops these so a dead server can't hang a client thread forever
+# (socket timeout below is the backstop).
+_MAX_WAIT_MS = 10_000
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, code: int, body: bytes) -> None:
+    sock.sendall(_HDR.pack(code, len(body)) + body)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
+    code, blen = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return code, _recv_exact(sock, blen) if blen else b""
+
+
+class BrokerServer:
+    """TCP front over a MemoryBroker; one thread per client connection.
+
+    The per-connection thread model matches the workload: a handful of
+    producer/consumer processes each holding one connection, with batch
+    receives doing the heavy lifting per round-trip.
+    """
+
+    def __init__(self, broker: Optional[MemoryBroker] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.broker = broker or MemoryBroker()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()
+        self._stopping = False
+        self._accept_thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # (topic, subscription) -> live socket-consumer count, for
+        # coordination (a test/parent can wait until N competitors
+        # joined before publishing).
+        self._consumer_counts: Dict[Tuple[str, str], int] = {}
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "BrokerServer":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="broker-accept", daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def consumer_count(self, topic: str, subscription: str) -> int:
+        with self._lock:
+            return self._consumer_counts.get((topic, subscription), 0)
+
+    # -- connection handling -------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_connection,
+                             args=(conn, addr),
+                             name=f"broker-conn-{addr[1]}",
+                             daemon=True).start()
+
+    def _serve_connection(self, conn: socket.socket, addr) -> None:
+        # handle -> (MemoryConsumer, topic, subscription) owned by THIS
+        # connection; a dropped connection requeues exactly these.
+        consumers: Dict[int, tuple] = {}
+        next_handle = 0
+        try:
+            while True:
+                try:
+                    op, body = _recv_frame(conn)
+                except ConnectionError:
+                    break
+                try:
+                    status, reply = self._handle(
+                        op, body, consumers,
+                        alloc=lambda: next_handle)
+                    if op == _OP_SUBSCRIBE and status == _ST_OK:
+                        next_handle += 1
+                except Exception as exc:  # protocol keeps flowing
+                    status, reply = _ST_ERROR, repr(exc).encode()
+                _send_frame(conn, status, reply)
+        finally:
+            conn.close()
+            # Cross-process crash takeover: close every consumer this
+            # connection owned (requeues its unacked messages).
+            for consumer, topic, sub in consumers.values():
+                consumer.close()
+                with self._lock:
+                    self._consumer_counts[(topic, sub)] -= 1
+
+    def _handle(self, op: int, body: bytes, consumers: Dict[int, tuple],
+                alloc) -> Tuple[int, bytes]:
+        if op == _OP_PRODUCE:
+            (tlen,) = struct.unpack_from("<H", body)
+            topic = body[2:2 + tlen].decode()
+            payload = body[2 + tlen:]
+            mid = self.broker.topic(topic).publish(payload)
+            return _ST_OK, struct.pack("<Q", mid)
+        if op == _OP_SUBSCRIBE:
+            (tlen,) = struct.unpack_from("<H", body)
+            topic = body[2:2 + tlen].decode()
+            (slen,) = struct.unpack_from("<H", body, 2 + tlen)
+            sub = body[4 + tlen:4 + tlen + slen].decode()
+            from attendance_tpu.transport.memory_broker import (
+                MemoryConsumer)
+            consumer = MemoryConsumer(
+                self.broker.topic(topic).subscription(sub))
+            handle = alloc()
+            consumers[handle] = (consumer, topic, sub)
+            with self._lock:
+                key = (topic, sub)
+                self._consumer_counts[key] = (
+                    self._consumer_counts.get(key, 0) + 1)
+            return _ST_OK, struct.pack("<I", handle)
+        if op == _OP_RECEIVE:
+            handle, max_n, timeout_ms = struct.unpack("<IIi", body)
+            consumer = consumers[handle][0]
+            timeout_ms = min(timeout_ms, _MAX_WAIT_MS)
+            try:
+                msgs = consumer.receive_many_raw(
+                    max_n, timeout_millis=timeout_ms)
+            except ReceiveTimeout:
+                return _ST_TIMEOUT, b""
+            parts = [struct.pack("<I", len(msgs))]
+            for mid, data, red in msgs:
+                parts.append(struct.pack("<QII", mid, red, len(data)))
+                parts.append(data)
+            return _ST_OK, b"".join(parts)
+        if op == _OP_ACK_IDS:
+            handle, n = struct.unpack_from("<II", body)
+            mids = struct.unpack_from(f"<{n}Q", body, 8)
+            consumers[handle][0].acknowledge_ids(mids)
+            return _ST_OK, b""
+        if op == _OP_NACK:
+            handle, mid = struct.unpack("<IQ", body)
+            consumers[handle][0].negative_acknowledge(
+                Message(b"", mid, 0))
+            return _ST_OK, b""
+        if op == _OP_BACKLOG:
+            (handle,) = struct.unpack("<I", body)
+            return _ST_OK, struct.pack(
+                "<Q", consumers[handle][0].backlog())
+        if op == _OP_CLOSE_CONSUMER:
+            (handle,) = struct.unpack("<I", body)
+            entry = consumers.pop(handle, None)
+            if entry is not None:
+                consumer, topic, sub = entry
+                consumer.close()
+                with self._lock:
+                    self._consumer_counts[(topic, sub)] -= 1
+            return _ST_OK, b""
+        return _ST_ERROR, f"unknown opcode {op}".encode()
+
+
+class _Rpc:
+    """One synchronous request/reply channel to the server (shared by a
+    client's producers and consumers under a lock — callers alternate
+    drain/publish anyway, and batching keeps round-trips rare)."""
+
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)))
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Backstop: the server bounds each blocking wait at
+        # _MAX_WAIT_MS, so a healthy server always replies well within
+        # this; only a dead/hung server trips it.
+        self._sock.settimeout(_MAX_WAIT_MS / 1000 + 30)
+        self._lock = threading.Lock()
+
+    def call(self, op: int, body: bytes) -> Tuple[int, bytes]:
+        with self._lock:
+            _send_frame(self._sock, op, body)
+            return _recv_frame(self._sock)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _check(status: int, reply: bytes) -> bytes:
+    if status == _ST_ERROR:
+        raise RuntimeError(f"broker error: {reply.decode(errors='replace')}")
+    return reply
+
+
+class SocketProducer:
+    def __init__(self, rpc: _Rpc, topic: str):
+        self._rpc = rpc
+        t = topic.encode()
+        self._prefix = struct.pack("<H", len(t)) + t
+        self._closed = False
+
+    def send(self, data: bytes) -> int:
+        if self._closed:
+            raise RuntimeError("producer closed")
+        status, reply = self._rpc.call(_OP_PRODUCE,
+                                       self._prefix + bytes(data))
+        (mid,) = struct.unpack("<Q", _check(status, reply))
+        return mid
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        self._closed = True
+
+
+class SocketConsumer:
+    """Consumer call-shape of MemoryConsumer over the socket protocol,
+    including the zero-wrapper raw lane (the bridge feature-detects
+    receive_many_raw) and batch acks."""
+
+    def __init__(self, rpc: _Rpc, handle: int):
+        self._rpc = rpc
+        self._handle = handle
+        self._closed = False
+
+    def receive_many_raw(self, max_n: int,
+                         timeout_millis: Optional[int] = None) -> list:
+        if self._closed:
+            raise RuntimeError("consumer closed")
+        import time as _time
+
+        # The server bounds one blocking wait at _MAX_WAIT_MS, so both
+        # long and absent timeouts are chunked client-side.
+        deadline = (None if timeout_millis is None
+                    else _time.monotonic() + timeout_millis / 1e3)
+        while True:
+            if deadline is None:
+                chunk = _MAX_WAIT_MS
+            else:
+                rem_ms = int((deadline - _time.monotonic()) * 1000)
+                if rem_ms <= 0:
+                    raise ReceiveTimeout(
+                        f"no message within {timeout_millis}ms")
+                chunk = min(rem_ms, _MAX_WAIT_MS)
+            status, reply = self._rpc.call(
+                _OP_RECEIVE, struct.pack("<IIi", self._handle, max_n,
+                                         int(chunk)))
+            if status == _ST_TIMEOUT:
+                continue  # deadline not reached yet: wait again
+            body = _check(status, reply)
+            (count,) = struct.unpack_from("<I", body)
+            out, off = [], 4
+            for _ in range(count):
+                mid, red, dlen = struct.unpack_from("<QII", body, off)
+                off += 16
+                out.append((mid, body[off:off + dlen], red))
+                off += dlen
+            return out
+
+    def receive_many(self, max_n: int,
+                     timeout_millis: Optional[int] = None) -> list:
+        return [Message(data, mid, red) for mid, data, red
+                in self.receive_many_raw(max_n, timeout_millis)]
+
+    def receive(self, timeout_millis: Optional[int] = None) -> Message:
+        return self.receive_many(1, timeout_millis)[0]
+
+    def acknowledge_ids(self, message_ids) -> None:
+        mids = list(message_ids)
+        body = struct.pack(f"<II{len(mids)}Q", self._handle, len(mids),
+                           *mids)
+        _check(*self._rpc.call(_OP_ACK_IDS, body))
+
+    def acknowledge(self, msg: Message) -> None:
+        self.acknowledge_ids([msg.message_id])
+
+    def acknowledge_many(self, msgs) -> None:
+        self.acknowledge_ids([m.message_id for m in msgs])
+
+    def negative_acknowledge(self, msg: Message) -> None:
+        # Only the id crosses the wire: the subscription re-derives the
+        # redelivery count from its own in-flight state on requeue.
+        _check(*self._rpc.call(
+            _OP_NACK, struct.pack("<IQ", self._handle, msg.message_id)))
+
+    def backlog(self) -> int:
+        status, reply = self._rpc.call(
+            _OP_BACKLOG, struct.pack("<I", self._handle))
+        (n,) = struct.unpack("<Q", _check(status, reply))
+        return n
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            _check(*self._rpc.call(
+                _OP_CLOSE_CONSUMER, struct.pack("<I", self._handle)))
+
+
+class SocketClient:
+    """pulsar.Client call-shape against a BrokerServer address."""
+
+    def __init__(self, address: str):
+        self._rpc = _Rpc(address)
+
+    def create_producer(self, topic: str) -> SocketProducer:
+        return SocketProducer(self._rpc, topic)
+
+    def subscribe(self, topic: str, subscription_name: str,
+                  consumer_type=None) -> SocketConsumer:
+        del consumer_type  # shared semantics, like the memory broker
+        t, s = topic.encode(), subscription_name.encode()
+        body = (struct.pack("<H", len(t)) + t
+                + struct.pack("<H", len(s)) + s)
+        status, reply = self._rpc.call(_OP_SUBSCRIBE, body)
+        (handle,) = struct.unpack("<I", _check(status, reply))
+        return SocketConsumer(self._rpc, handle)
+
+    def close(self) -> None:
+        self._rpc.close()
+
+
+def main(argv=None) -> None:
+    """Run a standalone broker process:
+    ``python -m attendance_tpu.transport.socket_broker`` (listens on
+    the Config.socket_broker default; ``--port 0`` for an ephemeral
+    port, printed on startup)."""
+    import argparse
+    import time
+
+    p = argparse.ArgumentParser(description="attendance_tpu socket broker")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    args = p.parse_args(argv)
+    server = BrokerServer(host=args.host, port=args.port).start()
+    print(f"broker listening on {server.address}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

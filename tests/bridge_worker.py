@@ -1,0 +1,47 @@
+"""Worker script for the 2-process competing-consumer bridge test.
+
+Run as a subprocess by tests/test_socket_broker.py (no ``test_``
+prefix, never collected):
+
+    python tests/bridge_worker.py <broker_addr> <out_json> <idle_s>
+
+Joins the shared bridge subscription on the socket broker — a second
+competing process, the reference's Pulsar Shared-subscription scale-out
+model (reference attendance_processor.py:30-34) on the framework's own
+cross-process transport — converts JSON messages to binary frames until
+the topic idles, then writes its accounting for the parent to aggregate.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    addr, out_path, idle_s = sys.argv[1], sys.argv[2], float(sys.argv[3])
+
+    # Hermetic CPU: the bridge is host-only, but importing the package
+    # initializes jax (keep it off the real-TPU tunnel in subprocesses).
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.bridge import JsonBinaryBridge
+    from attendance_tpu.transport.socket_broker import SocketClient
+
+    config = Config(transport_backend="socket", socket_broker=addr,
+                    batch_size=256, batch_timeout_s=0.02)
+    bridge = JsonBinaryBridge(config, client=SocketClient(addr))
+    bridge.run(idle_timeout_s=idle_s)
+    with open(out_path, "w") as f:
+        json.dump({"events": bridge.metrics.events,
+                   "batches": bridge.metrics.batches,
+                   "dead_lettered": bridge.metrics.dead_lettered}, f)
+    bridge.cleanup()
+    print("bridge worker done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
