@@ -80,8 +80,24 @@ def _ptr(arr: np.ndarray, typ):
 class HostPipe:
     """Typed wrapper over the hostpipe shared library."""
 
-    def __init__(self, lib: ctypes.CDLL):
+    def __init__(self, lib: ctypes.CDLL, path=None):
         self._lib = lib
+        # CPython-API list scan (hostpipe_py.c): bound through PyDLL —
+        # the GIL must stay HELD because the function reads Python
+        # bytes objects in place. Absent in the plain-hostpipe build;
+        # callers feature-detect via has_list_scan.
+        self._parse_list = None
+        if path is not None:
+            try:
+                pylib = ctypes.PyDLL(str(path))
+                fn = pylib.atp_parse_json_list
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [
+                    ctypes.py_object, ctypes.c_size_t, ctypes.c_size_t,
+                    _u32p, _u32p, ctypes.POINTER(ctypes.c_int64), _u8p]
+                self._parse_list = fn
+            except (OSError, AttributeError):
+                self._parse_list = None
         lib.atp_max_key.restype = ctypes.c_uint32
         lib.atp_max_key.argtypes = [_u8p, ctypes.c_size_t, ctypes.c_size_t]
         lib.atp_pack_words.restype = ctypes.c_int64
@@ -257,6 +273,38 @@ class HostPipe:
             return None, None, 0, 0, -2
         return buf, scan[0], db, scan[4], -1
 
+    @property
+    def has_list_scan(self) -> bool:
+        return self._parse_list is not None
+
+    def empty_json_outputs(self, n: int) -> "PreparedJsonBatch":
+        """Output-column holder for the list scan: same set_row/columns
+        surface as a prepared batch, without the joined buffer or
+        offset/length tables (the list scan reads payload bytes in
+        place and never consults them)."""
+        return PreparedJsonBatch(
+            buf=None, offs=None, lens=None,
+            student=np.empty(n, np.uint32), day=np.empty(n, np.uint32),
+            micros=np.empty(n, np.int64), flags=np.empty(n, np.uint8))
+
+    def parse_json_list(self, payloads: list, b: "PreparedJsonBatch",
+                        start: int) -> int:
+        """Scan payloads[start:] (a list of bytes) IN PLACE into the
+        output arrays — no join, no offset/length tables (at JSON-wire
+        rates that prepare pass costs more than the scan itself).
+        Same resume protocol as parse_json_from: -1 when everything
+        parsed, else the absolute index of the first non-bytes or
+        non-fast-shape payload."""
+        n = len(payloads)
+        if start >= n:
+            return -1
+        rc = self._parse_list(
+            payloads, start, n,
+            _ptr(b.student, _u32p), _ptr(b.day, _u32p),
+            b.micros.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _ptr(b.flags, _u8p))
+        return -1 if rc == 0 else int(rc - 1)
+
     def prepare_json_batch(self, payloads) -> "PreparedJsonBatch":
         """One-time O(total bytes) setup for a batch of JSON payloads;
         parse with :meth:`parse_json_from` (resumable by index, so a
@@ -362,7 +410,7 @@ def load() -> Optional[HostPipe]:
         if path is None:
             return None
         try:
-            _cached = HostPipe(ctypes.CDLL(str(path)))
+            _cached = HostPipe(ctypes.CDLL(str(path)), path=path)
             logger.info("native hostpipe loaded: %s", path.name)
         except OSError as exc:
             logger.warning("native hostpipe load failed: %s", exc)

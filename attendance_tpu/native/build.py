@@ -26,6 +26,10 @@ from typing import Optional
 logger = logging.getLogger(__name__)
 
 _SRC = Path(__file__).resolve().parent / "hostpipe.c"
+# Optional CPython-API variant: includes hostpipe.c and adds list-input
+# entry points (no join/length-table prepare pass). Built when Python.h
+# is available; plain hostpipe.c is the fallback.
+_SRC_PY = Path(__file__).resolve().parent / "hostpipe_py.c"
 
 
 def _cache_dir() -> Path:
@@ -42,12 +46,42 @@ def _compiler() -> Optional[str]:
     return None
 
 
+def _python_include() -> Optional[str]:
+    """Python.h's directory, or None when headers aren't installed."""
+    import sysconfig
+
+    inc = sysconfig.get_paths().get("include")
+    if inc and (Path(inc) / "Python.h").exists():
+        return inc
+    return None
+
+
 def build(force: bool = False) -> Optional[Path]:
     """Return the path of the built shared library, or None.
 
-    The build is atomic (compile to a temp file, rename into place) so
-    concurrent test workers never load a half-written object.
+    Tries the CPython-API variant (hostpipe_py.c, suffix ``-py``)
+    first when Python.h is available — native/__init__.py
+    feature-detects its extra symbols — and falls back to plain
+    hostpipe.c. The build is atomic (compile to a temp file, rename
+    into place) so concurrent test workers never load a half-written
+    object.
     """
+    inc = _python_include()
+    if inc is not None:
+        try:
+            tag_src = _SRC.read_bytes() + _SRC_PY.read_bytes()
+        except OSError:
+            tag_src = None
+        if tag_src is not None:
+            tag = hashlib.sha256(tag_src).hexdigest()[:16]
+            out = _cache_dir() / f"_hostpipe-{tag}-py.so"
+            if out.exists() and not force:
+                return out
+            built = _compile(_SRC_PY, out, extra=[f"-I{inc}"])
+            if built is not None:
+                return built
+            logger.info("native hostpipe: CPython-API variant build "
+                        "failed; falling back to plain hostpipe.c")
     try:
         src = _SRC.read_bytes()
     except OSError:
@@ -56,6 +90,11 @@ def build(force: bool = False) -> Optional[Path]:
     out = _cache_dir() / f"_hostpipe-{tag}.so"
     if out.exists() and not force:
         return out
+    return _compile(_SRC, out)
+
+
+def _compile(src_path: Path, out: Path,
+             extra: Optional[list] = None) -> Optional[Path]:
     cc = _compiler()
     if cc is None:
         logger.info("native hostpipe: no C compiler found; using numpy")
@@ -76,7 +115,7 @@ def build(force: bool = False) -> Optional[Path]:
     # in the process's global scope — dlopen would then fail exactly for
     # the out-of-CPython embedders the TSD destructor exists for.
     cmd = [cc, "-O3", "-march=native", "-std=c17", "-shared", "-fPIC",
-           "-pthread", "-o", tmp, str(_SRC)]
+           "-pthread", *(extra or []), "-o", tmp, str(src_path)]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)

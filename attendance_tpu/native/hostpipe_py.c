@@ -1,0 +1,46 @@
+/* CPython-API variant of the native host runtime.
+ *
+ * Wraps hostpipe.c (textual include — one translation unit, same
+ * flags) and adds entry points that take Python container objects
+ * directly, eliminating the per-batch join + offset/length-table
+ * setup of the buffer-based JSON scan: the scanner reads each
+ * payload's bytes IN PLACE via PyBytes_AS_STRING.  At JSON-wire rates
+ * the join+tables pass costs ~140ns/event — more than the scan
+ * itself — so this is the difference between the prepare step and no
+ * prepare step, not a micro-optimization.
+ *
+ * Build is OPTIONAL: native/build.py compiles this file when Python.h
+ * is available and falls back to plain hostpipe.c otherwise;
+ * native/__init__.py feature-detects the symbol.  Calls must come
+ * through ctypes.PyDLL (GIL held — the function touches Python
+ * objects); every other entry point keeps its plain CDLL binding with
+ * the GIL released.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include "hostpipe.c"
+
+/* Parse payloads[start:n] (a list of bytes objects) into the binary
+ * columns, reading each payload in place.  Returns 0 when everything
+ * parsed, or 1 + index of the first payload that is not bytes or
+ * falls outside the fast schema (caller Python-parses that one and
+ * resumes at index + 1) — the exact atp_parse_json_events protocol.
+ * The caller guarantees `list` is a PyList of length >= n that stays
+ * alive for the call; items are borrowed references. */
+int64_t atp_parse_json_list(PyObject *list, size_t start, size_t n,
+                            uint32_t *student, uint32_t *day,
+                            int64_t *micros, uint8_t *flags) {
+    for (size_t i = start; i < n; ++i) {
+        PyObject *o = PyList_GET_ITEM(list, (Py_ssize_t)i);
+        if (!PyBytes_Check(o))
+            return (int64_t)(i + 1);
+        const uint8_t *p = (const uint8_t *)PyBytes_AS_STRING(o);
+        size_t len = (size_t)PyBytes_GET_SIZE(o);
+        if (parse_one_json_event(p, p + len, &student[i], &day[i],
+                                 &micros[i], &flags[i]))
+            return (int64_t)(i + 1);
+    }
+    return 0;
+}

@@ -33,7 +33,8 @@ from attendance_tpu.pipeline.events import (
     encode_planar_batch)
 from attendance_tpu.pipeline.processor import ProcessorMetrics
 from attendance_tpu.transport import (
-    acknowledge_all, collect_batch, handle_poison, make_client)
+    acknowledge_all, collect_batch, collect_chunks, handle_poison,
+    make_client)
 
 logger = logging.getLogger(__name__)
 
@@ -57,19 +58,26 @@ class JsonBinaryBridge:
         self.metrics = ProcessorMetrics()
         # Detected once: the consumer is fixed at construction, and a
         # single flag keeps the drain and ack sites agreeing on the
-        # token shape.
+        # token shape. The chunk lane (whole batches tracked as ONE
+        # broker in-flight entry, settled wholesale) supersedes the raw
+        # lane when present: per-message broker bookkeeping is the
+        # bridge's dominant cost at JSON-wire rates.
+        self._chunk = hasattr(self.consumer, "receive_chunk")
         self._raw = hasattr(self.consumer, "receive_many_raw")
 
-    def _forward(self, payloads, acks) -> None:
+    def _forward(self, payloads, acks, chunks=None) -> None:
         """Convert one micro-batch and publish it.
 
         ``payloads`` are the raw JSON bytes; ``acks`` the matching ack
         tokens — raw ``(message_id, data, redeliveries)`` tuples on the
-        memory broker's zero-wrapper lane, Message objects otherwise
-        (see _drain). Message wrappers only materialize on the poison
-        path, which is off the steady-state budget by definition.
+        memory broker's zero-wrapper/chunk lanes, Message objects
+        otherwise (see _drain). On the chunk lane ``chunks`` holds the
+        (chunk_id, tuples) handles: the whole batch settles with one
+        broker op per chunk, and the chunks are EXPLODED into
+        per-message entries only on the poison path — which is off the
+        steady-state budget by definition.
         """
-        raw = self._raw
+        raw = self._raw or chunks is not None
         try:
             cols = decode_json_batch_columns(payloads)
             good = acks
@@ -83,6 +91,12 @@ class JsonBinaryBridge:
             # unrecoverable redelivery loop.
             from attendance_tpu.transport.memory_broker import Message
 
+            if chunks is not None:
+                # Per-message ack/nack needs per-message in-flight
+                # entries; the chunk handles stop existing here.
+                for cid, _ in chunks:
+                    self.consumer.explode_chunk(cid)
+                chunks = None
             good, parts = [], []
             for payload, tok in zip(payloads, acks):
                 try:
@@ -101,7 +115,10 @@ class JsonBinaryBridge:
         self.producer.send(encode_planar_batch(cols))
         # Ack strictly after the binary frame is published: the bridge
         # never holds the only copy of an acknowledged event.
-        if raw:
+        if chunks is not None:
+            for cid, _ in chunks:
+                self.consumer.acknowledge_chunk(cid)
+        elif raw:
             self.consumer.acknowledge_ids([t[0] for t in good])
         else:
             acknowledge_all(self.consumer, good)
@@ -110,29 +127,36 @@ class JsonBinaryBridge:
         self.metrics.batch_sizes.append(len(good))
 
     def _drain(self):
-        """One micro-batch as (payloads, ack_tokens). The memory
-        broker's raw lane skips Message construction entirely; clients
-        without it (real pulsar) take the Message path."""
+        """One micro-batch as (payloads, ack_tokens, chunk_handles).
+        The memory broker's chunk lane keeps broker bookkeeping per
+        BATCH; the raw lane skips Message construction; clients with
+        neither (real pulsar) take the Message path."""
+        if self._chunk:
+            chunks = collect_chunks(self.consumer, self.config.batch_size,
+                                    self.config.batch_timeout_s)
+            toks = ([t for _, ts in chunks for t in ts]
+                    if len(chunks) != 1 else chunks[0][1])
+            return [t[1] for t in toks], toks, chunks
         if self._raw:
             batch = collect_batch(self.consumer, self.config.batch_size,
                                   self.config.batch_timeout_s, raw=True)
-            return [t[1] for t in batch], batch
+            return [t[1] for t in batch], batch, None
         msgs = collect_batch(self.consumer, self.config.batch_size,
                              self.config.batch_timeout_s)
-        return [m.data() for m in msgs], msgs
+        return [m.data() for m in msgs], msgs, None
 
     def run(self, max_events: Optional[int] = None,
             idle_timeout_s: float = 1.0) -> None:
         t0 = time.perf_counter()
         idle_since = time.monotonic()
         while True:
-            payloads, acks = self._drain()
+            payloads, acks, chunks = self._drain()
             if not payloads:
                 if time.monotonic() - idle_since > idle_timeout_s:
                     break
                 continue
             idle_since = time.monotonic()
-            self._forward(payloads, acks)
+            self._forward(payloads, acks, chunks)
             if max_events is not None and self.metrics.events >= max_events:
                 break
         self.metrics.wall_seconds = time.perf_counter() - t0

@@ -254,7 +254,24 @@ def decode_json_batch_columns(payloads: Sequence[bytes]
     nat = load_native()
     if nat is None or not payloads:
         return columns_from_events(decode_event_batch(payloads))
-    payloads = [bytes(p) for p in payloads]
+    if getattr(nat, "has_list_scan", False) and isinstance(payloads, list):
+        # CPython-API scan: reads each bytes payload IN PLACE — no
+        # join, no offset/length tables (that prepare pass costs more
+        # per event than the scan itself). Non-bytes or non-fast-shape
+        # entries surface as misses and take the Python codec below.
+        batch = nat.empty_json_outputs(len(payloads))
+        idx = 0
+        while True:
+            miss = nat.parse_json_list(payloads, batch, idx)
+            if miss < 0:
+                return batch.columns()
+            batch.set_row(miss, columns_from_events(
+                [decode_event(bytes(payloads[miss]))]))
+            idx = miss + 1
+    # Buffer-based scan: one join + offset/length table, then the same
+    # resume protocol. No per-payload normalization pass — b"".join and
+    # len() accept any buffer type directly; only the rare Python-codec
+    # miss path needs real bytes.
     batch = nat.prepare_json_batch(payloads)  # one O(bytes) setup
     idx = 0
     while True:
@@ -265,7 +282,7 @@ def decode_json_batch_columns(payloads: Sequence[bytes]
         # straight into its output row), then resume the native scan
         # after it — O(1) setup per resume, not a tail re-join.
         batch.set_row(miss, columns_from_events(
-            [decode_event(payloads[miss])]))
+            [decode_event(bytes(payloads[miss]))]))
         idx = miss + 1
 
 

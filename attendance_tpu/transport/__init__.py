@@ -57,12 +57,33 @@ def handle_poison(msg, consumer, metrics, config, logger, *,
         consumer.negative_acknowledge(msg)
 
 
+def _fill_until(batch_size: int, timeout_s: float, step) -> None:
+    """THE partial-batch timeout rule, in one place: call
+    ``step(remaining_n, timeout_ms) -> received count`` until
+    ``batch_size`` messages arrived or ``timeout_s`` expired with at
+    least one (partial batch); a ReceiveTimeout from step ends the
+    batch."""
+    import time
+
+    total = 0
+    deadline = time.monotonic() + timeout_s
+    while total < batch_size:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0 and total:
+            break
+        timeout_ms = max(1, int(max(remaining, 0) * 1000))
+        try:
+            total += step(batch_size - total, timeout_ms)
+        except ReceiveTimeout:
+            break
+
+
 def collect_batch(consumer, batch_size: int, timeout_s: float,
                   raw: bool = False) -> list:
     """Fill a micro-batch from a consumer: up to ``batch_size`` messages,
     or whatever arrived when ``timeout_s`` expires (partial batch).
     Shared by every micro-batching consumer (processor, bridge) so the
-    partial-batch timeout rule has one definition.
+    partial-batch timeout rule has one definition (_fill_until).
 
     Uses the consumer's batch receive when it has one (the memory
     broker's receive_many drains pending messages under a single lock —
@@ -72,26 +93,37 @@ def collect_batch(consumer, batch_size: int, timeout_s: float,
     broker's zero-wrapper lane — ``(message_id, data, redeliveries)``
     tuples instead of Message objects; the caller must have
     feature-detected receive_many_raw."""
-    import time
-
     batch_recv = (consumer.receive_many_raw if raw
                   else getattr(consumer, "receive_many", None))
     msgs = []
-    deadline = time.monotonic() + timeout_s
-    while len(msgs) < batch_size:
-        remaining = deadline - time.monotonic()
-        if remaining <= 0 and msgs:
-            break
-        timeout_ms = max(1, int(max(remaining, 0) * 1000))
-        try:
-            if batch_recv is not None:
-                msgs.extend(batch_recv(batch_size - len(msgs),
-                                       timeout_millis=timeout_ms))
-            else:
-                msgs.append(consumer.receive(timeout_millis=timeout_ms))
-        except ReceiveTimeout:
-            break
+
+    def step(n, timeout_ms):
+        if batch_recv is not None:
+            got = batch_recv(n, timeout_millis=timeout_ms)
+            msgs.extend(got)
+            return len(got)
+        msgs.append(consumer.receive(timeout_millis=timeout_ms))
+        return 1
+
+    _fill_until(batch_size, timeout_s, step)
     return msgs
+
+
+def collect_chunks(consumer, batch_size: int, timeout_s: float) -> list:
+    """Fill a micro-batch on the CHUNK lane: a list of
+    (chunk_id, raw tuples) handles totalling up to ``batch_size``
+    messages, or whatever arrived when ``timeout_s`` expires. Same
+    partial-batch timeout rule as collect_batch (_fill_until); the
+    caller must have feature-detected receive_chunk."""
+    chunks = []
+
+    def step(n, timeout_ms):
+        cid, toks = consumer.receive_chunk(n, timeout_millis=timeout_ms)
+        chunks.append((cid, toks))
+        return len(toks)
+
+    _fill_until(batch_size, timeout_s, step)
+    return chunks
 
 
 def acknowledge_all(consumer, msgs) -> None:

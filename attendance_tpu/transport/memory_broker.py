@@ -56,15 +56,99 @@ class _Subscription:
 
     def __init__(self, name: str):
         self.name = name
-        self.pending: Deque[Tuple[int, bytes, int]] = deque()
+        # Pending messages, block-structured: sealed blocks are
+        # [entries_list, consumed_offset] pairs; _tail is the open
+        # block single-message enqueues append to (sealed lazily).
+        # Bulk enqueues hand their WHOLE entries list over as one
+        # block, and bulk receives slice blocks back out — so the
+        # per-message cost of the bulk lanes is one list-slot copy,
+        # not a deque popleft + tuple churn each (the dominant broker
+        # cost at JSON-wire rates). Block entry lists may be SHARED
+        # (publish_many passes one list to every subscription); they
+        # are immutable by convention — only the offset advances.
+        self._blocks: Deque[list] = deque()
+        self._tail: list = []
+        self._count = 0
         # message_id -> (payload, redeliveries, owner consumer id)
         self.inflight: Dict[int, Tuple[bytes, int, int]] = {}
+        # chunk_id -> (list of (mid, payload, red), owner) — the chunk
+        # lane's whole-batch in-flight entries (see receive_chunk).
+        self.chunk_inflight: Dict[int, Tuple[list, int]] = {}
+        self._chunk_ids = itertools.count()
         self.cond = threading.Condition()
+        # Consumers currently blocked in a wait. Producers skip the
+        # (expensive, ~1us) notify when nobody is waiting — at JSON-wire
+        # rates the per-message publish cost is dominated by it.
+        self._waiting = 0
+
+    def _notify_if_waiting(self, n: int = 1) -> None:
+        """Wake up to ``n`` blocked consumers — one per enqueued
+        message, not one per enqueue call: a bulk block must wake every
+        competing consumer it can feed, or all but one sleep through a
+        full queue (lost wakeup)."""
+        if self._waiting:
+            self.cond.notify(min(self._waiting, n))
+
+    # -- pending-queue internals (cond held) --------------------------------
+    def _append_one(self, entry: Tuple[int, bytes, int]) -> None:
+        self._tail.append(entry)
+        self._count += 1
+
+    def _append_block(self, entries: list) -> None:
+        if not entries:
+            return
+        if self._tail:
+            self._blocks.append([self._tail, 0])
+            self._tail = []
+        self._blocks.append([entries, 0])
+        self._count += len(entries)
+
+    def _pop_entries(self, max_n: int) -> list:
+        """Up to max_n pending tuples in FIFO order (cond held,
+        _count > 0). Whole-block handovers return the block's list
+        itself (owned by this subscription — see enqueue_many) with
+        zero per-message work; receivers treat returned token lists as
+        read-only until settled (chunk entries alias them)."""
+        k = min(max_n, self._count)
+        self._count -= k
+        parts = []
+        taken = 0
+        while taken < k:
+            if not self._blocks:
+                self._blocks.append([self._tail, 0])
+                self._tail = []
+            blk = self._blocks[0]
+            lst, off = blk
+            avail = len(lst) - off
+            take = min(k - taken, avail)
+            if take == avail:
+                self._blocks.popleft()
+                parts.append(lst if off == 0 else lst[off:])
+            else:
+                parts.append(lst[off:off + take])
+                blk[1] = off + take
+            taken += take
+        if len(parts) == 1:
+            return parts[0]
+        return [t for p in parts for t in p]
 
     def enqueue(self, message_id: int, data: bytes, redeliveries: int = 0):
         with self.cond:
-            self.pending.append((message_id, data, redeliveries))
-            self.cond.notify()
+            self._append_one((message_id, data, redeliveries))
+            self._notify_if_waiting()
+
+    def enqueue_many(self, entries) -> None:
+        """Bulk enqueue of (mid, data, redeliveries) tuples: one lock
+        acquisition, one block handover, one notify per waiting
+        consumer it can feed. The subscription takes OWNERSHIP of a
+        list argument (whole-block pops hand it back out); callers
+        sharing one list across subscriptions must pass copies
+        (publish_many does)."""
+        entries = (entries if isinstance(entries, list)
+                   else list(entries))
+        with self.cond:
+            self._append_block(entries)
+            self._notify_if_waiting(len(entries))
 
     def receive(self, timeout_s: Optional[float],
                 owner: int) -> Message:
@@ -78,29 +162,87 @@ class _Subscription:
         per-event budget is microseconds (the JSON bridge). Blocks
         until at least one message is available or the timeout
         expires."""
+        def register(popped):
+            self.inflight.update(
+                (mid, (data, red, owner)) for mid, data, red in popped)
+
+        return self._pop_pending(max_n, timeout_s, register)
+
+    def _pop_pending(self, max_n: int, timeout_s: Optional[float],
+                     register=None) -> list:
+        """Block until pending is non-empty (or timeout), then bulk-pop
+        up to max_n tuples under one lock acquisition (block handover:
+        see _pop_entries). ``register`` runs on the popped list UNDER
+        THE SAME LOCK — pop and in-flight registration must be atomic,
+        or a concurrent close()'s requeue_inflight could run in the
+        window where messages exist in neither pending nor inflight
+        and lose them."""
         deadline = (None if timeout_s is None
                     else time.monotonic() + timeout_s)
         with self.cond:
             # Loop: a competing consumer may steal the message between
             # notify and wake-up, and waits can wake spuriously.
-            while not self.pending:
-                if deadline is None:
-                    self.cond.wait()
-                    continue
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise ReceiveTimeout(
-                        f"no message within {timeout_s}s on {self.name!r}")
-                self.cond.wait(remaining)
-            # Bulk-pop then comprehensions: at JSON-wire rates this
-            # loop IS the receive cost (hundreds of thousands of
-            # per-message iterations/s), and comprehension + dict.update
-            # run ~2x the interpreted append-per-message form.
-            k = min(max_n, len(self.pending))
-            popped = [self.pending.popleft() for _ in range(k)]
-            self.inflight.update(
-                (mid, (data, red, owner)) for mid, data, red in popped)
+            while not self._count:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise ReceiveTimeout(
+                            f"no message within {timeout_s}s "
+                            f"on {self.name!r}")
+                self._waiting += 1
+                try:
+                    self.cond.wait(remaining)
+                finally:
+                    self._waiting -= 1
+            popped = self._pop_entries(max_n)
+            if register is not None:
+                register(popped)
             return popped
+
+    def receive_chunk(self, max_n: int, timeout_s: Optional[float],
+                      owner: int) -> Tuple[int, list]:
+        """The chunk lane: like receive_many_raw, but the whole batch
+        is tracked as ONE in-flight entry keyed by a chunk id — the
+        per-message inflight dict traffic (the dominant broker cost at
+        JSON-wire rates) drops to one dict op per BATCH. The caller
+        settles the chunk wholesale (acknowledge_chunk / nack_chunk) or
+        explodes it into per-message entries when it needs per-message
+        ack/nack (the poison path)."""
+        cid_box = []
+
+        def register(popped):
+            cid = next(self._chunk_ids)
+            self.chunk_inflight[cid] = (popped, owner)
+            cid_box.append(cid)
+
+        popped = self._pop_pending(max_n, timeout_s, register)
+        return cid_box[0], popped
+
+    def acknowledge_chunk(self, chunk_id: int) -> None:
+        with self.cond:
+            self.chunk_inflight.pop(chunk_id, None)
+
+    def nack_chunk(self, chunk_id: int) -> None:
+        """Wholesale negative-ack: requeue every message of the chunk
+        with a bumped redelivery count."""
+        with self.cond:
+            entry = self.chunk_inflight.pop(chunk_id, None)
+            if entry is not None:
+                self._append_block(
+                    [(mid, data, red + 1) for mid, data, red in entry[0]])
+                self._notify_if_waiting()
+
+    def explode_chunk(self, chunk_id: int) -> None:
+        """Convert a chunk's messages into ordinary per-message
+        in-flight entries so the per-message ack/nack surface applies
+        (rare: the bridge's poison path)."""
+        with self.cond:
+            entry = self.chunk_inflight.pop(chunk_id, None)
+            if entry is not None:
+                popped, owner = entry
+                self.inflight.update(
+                    (mid, (data, red, owner)) for mid, data, red in popped)
 
     def receive_many(self, max_n: int, timeout_s: Optional[float],
                      owner: int) -> list:
@@ -123,24 +265,33 @@ class _Subscription:
             entry = self.inflight.pop(message_id, None)
             if entry is not None:
                 data, redeliveries, _ = entry
-                self.pending.append((message_id, data, redeliveries + 1))
-                self.cond.notify()
+                self._append_one((message_id, data, redeliveries + 1))
+                self._notify_if_waiting()
 
     def requeue_inflight(self, owner: int) -> None:
         """Crash takeover: return the closing consumer's own unacked
-        messages to the queue (other consumers' deliveries stay theirs)."""
+        messages (per-message AND chunk entries) to the queue; other
+        consumers' deliveries stay theirs."""
         with self.cond:
             mine = [(mid, d, r) for mid, (d, r, o) in self.inflight.items()
                     if o == owner]
             for mid, data, redeliveries in mine:
                 del self.inflight[mid]
-                self.pending.append((mid, data, redeliveries + 1))
-            if mine:
+                self._append_one((mid, data, redeliveries + 1))
+            my_chunks = [cid for cid, (_, o) in self.chunk_inflight.items()
+                         if o == owner]
+            for cid in my_chunks:
+                popped, _ = self.chunk_inflight.pop(cid)
+                self._append_block(
+                    [(mid, data, red + 1) for mid, data, red in popped])
+            if mine or my_chunks:
                 self.cond.notify_all()
 
     def backlog(self) -> int:
         with self.cond:
-            return len(self.pending) + len(self.inflight)
+            return (self._count + len(self.inflight)
+                    + sum(len(popped) for popped, _
+                          in self.chunk_inflight.values()))
 
 
 class _Topic:
@@ -158,8 +309,8 @@ class _Topic:
                 sub = self.subscriptions[name] = _Subscription(name)
                 # A new subscription starts at the earliest retained
                 # message (the generator may run before the processor).
-                for mid, data in self.retained:
-                    sub.enqueue(mid, data)
+                sub.enqueue_many([(mid, data, 0)
+                                  for mid, data in self.retained])
             return sub
 
     def publish(self, data: bytes) -> int:
@@ -170,6 +321,25 @@ class _Topic:
         for sub in subs:
             sub.enqueue(mid, data)
         return mid
+
+    def publish_many(self, datas) -> int:
+        """Bulk publish: one id/retention pass and one enqueue_many per
+        subscription for the whole batch (per-message publish pays a
+        lock round-trip per message — at JSON-wire rates that alone is
+        ~1.4us/message). Returns the FIRST assigned message id; ids are
+        consecutive."""
+        with self.lock:
+            entries = [(next(self._ids), bytes(d)) for d in datas]
+            self.retained.extend(entries)
+            subs = list(self.subscriptions.values())
+        tuples = [(mid, d, 0) for mid, d in entries]
+        # Each subscription takes ownership of its block (whole-block
+        # pops hand the list back out): one shared list across subs
+        # would alias a consumer's returned batch with another sub's
+        # live pending queue.
+        for i, sub in enumerate(subs):
+            sub.enqueue_many(tuples if i == 0 else list(tuples))
+        return entries[0][0] if entries else -1
 
 
 class MemoryBroker:
@@ -211,6 +381,13 @@ class MemoryProducer:
         if self._closed:
             raise RuntimeError("producer closed")
         return self._topic.publish(bytes(data))
+
+    def send_many(self, datas) -> int:
+        """Bulk send (memory-broker extension; callers feature-detect):
+        one broker pass for the whole batch. Returns the first id."""
+        if self._closed:
+            raise RuntimeError("producer closed")
+        return self._topic.publish_many(datas)
 
     def flush(self) -> None:
         pass
@@ -255,6 +432,28 @@ class MemoryConsumer:
             raise RuntimeError("consumer closed")
         timeout_s = None if timeout_millis is None else timeout_millis / 1e3
         return self._sub.receive_many_raw(max_n, timeout_s, self._id)
+
+    def receive_chunk(self, max_n: int,
+                      timeout_millis: Optional[int] = None
+                      ) -> Tuple[int, list]:
+        """Chunk-lane batch receive: (chunk_id, raw tuples). The whole
+        chunk is ONE in-flight entry; settle it with acknowledge_chunk
+        / nack_chunk, or explode_chunk into per-message entries for the
+        per-message ack/nack surface (poison handling). Memory-broker
+        extension; callers feature-detect."""
+        if self._closed:
+            raise RuntimeError("consumer closed")
+        timeout_s = None if timeout_millis is None else timeout_millis / 1e3
+        return self._sub.receive_chunk(max_n, timeout_s, self._id)
+
+    def acknowledge_chunk(self, chunk_id: int) -> None:
+        self._sub.acknowledge_chunk(chunk_id)
+
+    def nack_chunk(self, chunk_id: int) -> None:
+        self._sub.nack_chunk(chunk_id)
+
+    def explode_chunk(self, chunk_id: int) -> None:
+        self._sub.explode_chunk(chunk_id)
 
     def acknowledge_ids(self, message_ids) -> None:
         self._sub.acknowledge_many(message_ids)

@@ -366,15 +366,13 @@ def bench_json(seconds: float, capacity: int, num_banks: int,
     producer = MemoryClient(broker).create_producer(config.pulsar_topic)
 
     # warmup: one bridge batch + one pipe frame compiles the one shape
-    for p in payloads[:bridge_batch]:
-        producer.send(p)
+    producer.send_many(payloads[:bridge_batch])
     bridge.run(max_events=bridge_batch, idle_timeout_s=0.2)
     pipe.run(max_events=bridge_batch, idle_timeout_s=0.2)
 
     rates, bridge_rates, pipe_rates = [], [], []
     for _ in range(5):
-        for p in payloads:
-            producer.send(p)
+        producer.send_many(payloads)
         bridge.metrics.events = 0
         pipe.metrics.events = 0
         bridge.run(max_events=num_events, idle_timeout_s=5.0)

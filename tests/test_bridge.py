@@ -96,6 +96,40 @@ def test_decode_json_batch_columns_fallback_identical():
                        _python_columns(batch))
 
 
+def test_list_scan_matches_buffer_scan_and_python():
+    """The CPython-API list scan (payload bytes read in place, no
+    join/offset-table prepare) must agree with the buffer scan and the
+    Python codec on accepted payloads, refuse the same fallback
+    shapes, and surface non-bytes entries as misses at their index."""
+    from attendance_tpu.native import load as load_native
+    nat = load_native()
+    if nat is None or not nat.has_list_scan:
+        pytest.skip("CPython-API hostpipe variant unavailable")
+
+    # decode_json_batch_columns prefers the list scan for list inputs;
+    # a mixed batch must still match the pure-Python answer.
+    batch = FAST_SHAPES + FALLBACK_SHAPES + FAST_SHAPES[:3]
+    _assert_cols_equal(decode_json_batch_columns(list(batch)),
+                       _python_columns(batch))
+
+    # Direct: list scan == buffer scan on the all-fast batch.
+    out = nat.empty_json_outputs(len(FAST_SHAPES))
+    assert nat.parse_json_list(list(FAST_SHAPES), out, 0) == -1
+    cols_buf, miss = nat.parse_json_events(FAST_SHAPES)
+    assert miss == -1
+    _assert_cols_equal(out.columns(), cols_buf)
+
+    # A non-bytes element (memoryview) is a miss at its index — the
+    # resume protocol hands exactly that entry to the Python codec.
+    mixed = list(FAST_SHAPES) + [memoryview(_payload())] + [_payload()]
+    out2 = nat.empty_json_outputs(len(mixed))
+    assert nat.parse_json_list(mixed, out2, 0) == len(FAST_SHAPES)
+    assert nat.parse_json_list(mixed, out2,
+                               len(FAST_SHAPES) + 1) == -1
+    _assert_cols_equal(decode_json_batch_columns(mixed),
+                       _python_columns([bytes(p) for p in mixed]))
+
+
 def test_bridge_end_to_end_with_fused_pipeline():
     """Reference-wire JSON producer -> bridge -> fused pipeline: the
     stored events match the generator's ground truth exactly."""
@@ -301,6 +335,15 @@ def test_json_scanner_differential_fuzz():
         payload = bytes(p)
         mutations += 1
         cols, miss = nat.parse_json_events([payload])
+        if nat.has_list_scan:
+            # Both scan front-ends share parse_one_json_event; the
+            # fuzz pins that they accept/refuse identically and land
+            # on the same columns.
+            out = nat.empty_json_outputs(1)
+            miss_l = nat.parse_json_list([payload], out, 0)
+            assert (miss_l == -1) == (miss == -1), payload
+            if miss == -1:
+                _assert_cols_equal(out.columns(), cols)
         if miss != -1:
             continue  # scanner bailed: always safe
         # scanner accepted: Python must agree bit-for-bit

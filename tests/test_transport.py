@@ -155,3 +155,122 @@ def test_raw_drain_lane_bookkeeping():
     assert all(t[2] >= 1 for t in taken)  # all are redeliveries now
     c2.acknowledge_ids([t[0] for t in taken])
     assert c2.backlog() == 0
+
+
+def test_chunk_lane_semantics():
+    """receive_chunk tracks the whole batch as ONE in-flight entry:
+    acknowledge_chunk settles it wholesale, nack_chunk requeues every
+    message with a bumped count, explode_chunk converts to per-message
+    entries for the poison path, and a consumer crash requeues owned
+    chunks for takeover."""
+    client = make_client()
+    consumer = client.subscribe("t", "sub")
+    prod = client.create_producer("t")
+    prod.send_many([b"m%d" % i for i in range(8)])
+
+    cid, toks = consumer.receive_chunk(4, timeout_millis=200)
+    assert [t[1] for t in toks] == [b"m0", b"m1", b"m2", b"m3"]
+    assert consumer.backlog() == 8  # 4 pending + 4 chunk-inflight
+    consumer.acknowledge_chunk(cid)
+    assert consumer.backlog() == 4
+
+    # nack_chunk: wholesale redelivery with bumped counts.
+    cid2, toks2 = consumer.receive_chunk(2, timeout_millis=200)
+    consumer.nack_chunk(cid2)
+    cid3, toks3 = consumer.receive_chunk(10, timeout_millis=200)
+    got = {t[1]: t[2] for t in toks3}
+    assert got[b"m6"] == 0 and got[b"m7"] == 0
+    assert got[b"m4"] == 1 and got[b"m5"] == 1  # requeued after m6/m7
+
+    # explode: per-message ack/nack applies to the chunk's messages.
+    consumer.explode_chunk(cid3)
+    consumer.acknowledge_ids([t[0] for t in toks3 if t[1] != b"m4"])
+    from attendance_tpu.transport.memory_broker import Message
+    m4 = next(t for t in toks3 if t[1] == b"m4")
+    consumer.negative_acknowledge(Message(m4[1], m4[0], m4[2]))
+
+    # crash takeover: the redelivered m4 is drained into a chunk owned
+    # by the dying consumer, then requeued for the survivor.
+    cid4, toks4 = consumer.receive_chunk(10, timeout_millis=200)
+    assert [t[1] for t in toks4] == [b"m4"]
+    consumer.close()
+    c2 = client.subscribe("t", "sub")
+    cid5, toks5 = c2.receive_chunk(10, timeout_millis=500)
+    assert [t[1] for t in toks5] == [b"m4"]
+    assert toks5[0][2] >= 2  # nacked once + takeover requeue
+    c2.acknowledge_chunk(cid5)
+    assert c2.backlog() == 0
+
+
+def test_send_many_preserves_order_and_interleaves_with_send():
+    """publish_many hands one block to every subscription; ordering
+    with interleaved single sends stays FIFO and ids stay consecutive
+    within the batch."""
+    client = make_client()
+    consumer = client.subscribe("t", "sub")
+    prod = client.create_producer("t")
+    prod.send(b"a")
+    first = prod.send_many([b"b", b"c", b"d"])
+    prod.send(b"e")
+    prod.send_many([b"f"])
+    msgs = consumer.receive_many(10, timeout_millis=200)
+    assert [m.data() for m in msgs] == [b"a", b"b", b"c", b"d", b"e", b"f"]
+    mids = [m.message_id for m in msgs]
+    assert mids == sorted(mids)
+    assert mids[1] == first and mids[3] == first + 2
+    consumer.acknowledge_many(msgs)
+    assert consumer.backlog() == 0
+
+
+def test_late_subscription_replays_retained_through_blocks():
+    """A late subscription's retained replay and a shared bulk block
+    must coexist: two subs draining the same published block see the
+    same messages independently."""
+    client = make_client()
+    prod = client.create_producer("t")
+    prod.send_many([b"x%d" % i for i in range(5)])
+    c1 = client.subscribe("t", "s1")
+    c2 = client.subscribe("t", "s2")
+    for c in (c1, c2):
+        cid, toks = c.receive_chunk(10, timeout_millis=200)
+        assert [t[1] for t in toks] == [b"x%d" % i for i in range(5)]
+        c.acknowledge_chunk(cid)
+        assert c.backlog() == 0
+
+
+def test_bulk_publish_wakes_all_blocked_consumers():
+    """A bulk block must wake one waiter PER MESSAGE it can feed, not
+    one per enqueue call — with two consumers blocked in untimed
+    receives, one publish_many of two messages must unblock both
+    (lost-wakeup regression on the block-structured queue)."""
+    client = make_client()
+    c1 = client.subscribe("t", "sub")
+    c2 = client.subscribe("t", "sub")
+    got = []
+    lock = threading.Lock()
+
+    def worker(c):
+        m = c.receive(timeout_millis=5000)
+        with lock:
+            got.append(m.data())
+        c.acknowledge(m)
+
+    threads = [threading.Thread(target=worker, args=(c,))
+               for c in (c1, c2)]
+    for t in threads:
+        t.start()
+    # Wait until BOTH are parked in cond.wait before publishing.
+    sub = client._broker.topic("t").subscription("sub")
+    deadline = 50
+    import time as _t
+    for _ in range(deadline * 10):
+        with sub.cond:
+            if sub._waiting == 2:
+                break
+        _t.sleep(0.01)
+    client.create_producer("t").send_many([b"a", b"b"])
+    for t in threads:
+        t.join(timeout=5)
+    assert not any(t.is_alive() for t in threads), \
+        "a consumer slept through a bulk publish (lost wakeup)"
+    assert sorted(got) == [b"a", b"b"]
