@@ -219,10 +219,24 @@ def make_jitted_step_words(params: BloomParams, key_bits: int,
 def pack_words(keys, banks, key_bits: int, padded: int):
     """Host-side pack: uint32[padded] of ``bank << key_bits | key`` with
     all-ones words on the padding lanes. numpy reference implementation —
-    the native host runtime fuses this into its decode pass."""
+    the native host runtime fuses this into its decode pass.
+
+    A real bank id equal to the all-ones bank field would be decoded as
+    PADDING (fused_step_words' sentinel) and silently dropped from the
+    HLL/counters — a direct caller passing a too-narrow ``key_bits``
+    must fail loudly instead (the pipeline dispatcher always checks
+    ``kw + num_banks.bit_length() <= 32`` before packing; raw engine
+    drivers get this guard)."""
     import numpy as np
 
     n = len(keys)
+    if n:
+        sentinel = (1 << (32 - key_bits)) - 1
+        if int(np.max(banks)) >= sentinel:
+            raise ValueError(
+                f"pack_words: bank id >= {sentinel} collides with the "
+                f"padding sentinel at key_bits={key_bits} (bank field "
+                f"is {32 - key_bits} bits)")
     out = np.empty(padded, np.uint32)
     np.left_shift(np.asarray(banks, np.uint32), np.uint32(key_bits),
                   out=out[:n])

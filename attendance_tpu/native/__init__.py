@@ -314,12 +314,21 @@ class HostPipe:
         # interpreter round-trip per payload (measured on the bridge's
         # JSON hot path).
         lens = np.array(list(map(len, payloads)), np.uint32)
+        buf = np.frombuffer(b"".join(payloads), np.uint8)
+        if int(lens.sum()) != buf.size:
+            # A buffer payload with itemsize > 1 (e.g. a uint32 view):
+            # len() counts ELEMENTS but join copies BYTES, so the
+            # offset table would misalign every later payload. One
+            # aggregate check keeps the all-bytes hot path free; the
+            # odd batch pays a normalization pass.
+            payloads = [bytes(p) for p in payloads]
+            lens = np.array(list(map(len, payloads)), np.uint32)
+            buf = np.frombuffer(b"".join(payloads), np.uint8)
         offs = np.zeros(n, np.uint64)
         if n > 1:
             np.cumsum(lens[:-1], out=offs[1:])
         return PreparedJsonBatch(
-            buf=np.frombuffer(b"".join(payloads), np.uint8),
-            offs=offs, lens=lens,
+            buf=buf, offs=offs, lens=lens,
             student=np.empty(n, np.uint32), day=np.empty(n, np.uint32),
             micros=np.empty(n, np.int64), flags=np.empty(n, np.uint8))
 

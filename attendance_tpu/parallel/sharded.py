@@ -44,7 +44,6 @@ traffic stays local and only the 1-byte-per-key answer rides ICI.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
@@ -114,19 +113,29 @@ class ShardedSketchEngine:
             raise ValueError(f"sp={self.sp} must divide {self.m_regs}")
         self.num_banks = num_banks
         self._word_step_cache = {}
+        # Degenerate-mesh specialization: on a ONE-device mesh every
+        # collective is an identity and the partitioned program is
+        # value-identical to the plain single-chip program — so the
+        # kernels compile WITHOUT shard_map and state lives as ordinary
+        # device arrays. This is not just cleanliness: on relay-
+        # tunneled single chips, SPMD-partitioned executables execute
+        # through a degraded path (~2000x — PARITY.md "Sharded step on
+        # the tunneled chip", bisected r04: the slowdown is a property
+        # of the partitioned executable CLASS, not of any kernel
+        # content), while the identical un-partitioned program runs at
+        # full speed. Multi-device meshes are untouched.
+        self.single = (self.sp * self.dp) == 1
 
-        bits_sharding = NamedSharding(mesh, P("sp"))
         # HLL registers carry a leading replica axis: regs[r] is replica
         # r's private register copy (sharded over "dp"; register axis
         # over "sp"). In "step" mode every step's pmax keeps all copies
         # identical; in "query" mode they diverge freely and the
         # commutative max-union happens once at histogram time.
-        regs_sharding = NamedSharding(mesh, P("dp", None, "sp"))
-        self.bits = jax.device_put(
-            jnp.zeros((self.m_words,), jnp.uint32), bits_sharding)
-        self.regs = jax.device_put(
+        self.bits = self._put(jnp.zeros((self.m_words,), jnp.uint32),
+                              P("sp"))
+        self.regs = self._put(
             jnp.zeros((self.dp, num_banks, self.m_regs), jnp.uint8),
-            regs_sharding)
+            P("dp", None, "sp"))
         # Device-side (valid, invalid) totals — the single-chip fused
         # step's two-lane 64-bit counters (models.fused.SketchState),
         # one private (2, 2) block per dp replica (every sp device of a
@@ -135,13 +144,99 @@ class ShardedSketchEngine:
         # sum over replicas at read time. Closes the r02 gap: the mesh
         # surfaced no validity totals at all
         # (observability contract: reference attendance_processor.py:131).
-        self.counts = jax.device_put(
-            np.zeros((self.dp, 2, 2), np.uint32),
-            NamedSharding(mesh, P("dp")))
+        self.counts = self._put(np.zeros((self.dp, 2, 2), np.uint32),
+                                P("dp"))
         self._build_kernels()
+
+    def _put(self, arr, spec: P):
+        """State placement: mesh-sharded normally, a plain device_put
+        onto the mesh's only device in the degenerate single-device
+        case (mesh-annotated arrays would pull the computations back
+        into the partitioned-executable class the specialization
+        exists to avoid)."""
+        if self.single:
+            return jax.device_put(arr, self.mesh.devices.flat[0])
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    # -- degenerate single-device kernels ------------------------------------
+    def _build_single_kernels(self) -> None:
+        """The 1-device mesh compiles THE single-chip kernel suite
+        (models.fused / models.bloom / models.hll) behind the engine's
+        state layout — bit-identical to both the multi-device kernels
+        (pinned by tests/test_sharded.py cross-shape equality) and the
+        FusedPipeline single-chip path, BY CONSTRUCTION: they are the
+        same compiled programs plus free axis-0 views. Besides zero
+        kernel drift, this is what sidesteps the tunneled-chip
+        pathology (__init__ notes): these exact programs are the ones
+        the e2e bench proves run at full speed here."""
+        from attendance_tpu.models.bloom import (
+            bloom_add_packed, bloom_contains_words)
+        from attendance_tpu.models.fused import (
+            SketchState, fused_step, fused_step_delta, fused_step_seg,
+            fused_step_words)
+        from attendance_tpu.models.hll import best_histogram
+
+        params = self.params
+        precision = self.precision
+        m_bits_real = params.m_bits
+
+        def repack(state, valid):
+            return valid, state.hll_regs[None], state.counts[None]
+
+        def unpack(bits, regs, counts):
+            return SketchState(bits, regs[0], counts[0])
+
+        self._preload = jax.jit(
+            lambda b, k, m: bloom_add_packed(b, k, params),
+            donate_argnums=(0,))
+
+        def step_fn(bits, regs, counts, keys, bank_idx, mask):
+            state, valid = fused_step(unpack(bits, regs, counts), keys,
+                                      bank_idx, mask, params, precision)
+            return repack(state, valid)
+
+        self._step = jax.jit(step_fn, donate_argnums=(1, 2))
+
+        def make_step_words(kw: int):
+            def f(bits, regs, counts, words):
+                state, valid = fused_step_words(
+                    unpack(bits, regs, counts), words, params, kw,
+                    precision)
+                return repack(state, valid)
+            return jax.jit(f, donate_argnums=(1, 2))
+
+        self._make_step_words = make_step_words
+
+        def make_step_narrow(mode: str, width: int, padded_local: int,
+                             nbanks: int):
+            fn = fused_step_seg if mode == "seg" else fused_step_delta
+
+            def f(bits, regs, counts, bufs):
+                state, valid = fn(unpack(bits, regs, counts), bufs[0],
+                                  params, width, padded_local, nbanks,
+                                  precision)
+                return repack(state, valid)
+            return jax.jit(f, donate_argnums=(1, 2))
+
+        self._make_step_narrow = make_step_narrow
+        self._query = jax.jit(
+            lambda bits, keys: bloom_contains_words(bits, keys, params))
+        self._hist = jax.jit(
+            lambda regs: best_histogram(regs[0], precision))
+        self._fill = jax.jit(
+            lambda bits: jnp.sum(jax.lax.population_count(
+                bits).astype(jnp.float32)) / jnp.float32(m_bits_real))
+        self._merge_regs = jax.jit(lambda r: jnp.max(r, axis=0))
+        self._read_counts = jax.jit(lambda c: c)
 
     # -- shard_map kernels --------------------------------------------------
     def _build_kernels(self) -> None:
+        """One set of kernel BODIES for every mesh shape; collectives
+        and the shard_map wrapper are gated on ``self.single`` (size-1
+        axes make them identities — see __init__)."""
+        if self.single:
+            self._build_single_kernels()
+            return
         mesh = self.mesh
         params = self.params
         precision = self.precision
@@ -165,6 +260,10 @@ class ShardedSketchEngine:
             probes = jnp.where(
                 in_range, (word >> bit) & jnp.uint32(1), jnp.uint32(1))
             return jnp.all(probes == jnp.uint32(1), axis=1)
+
+        def and_sp(partial):
+            """Validity AND across "sp": min-reduce of {0,1}."""
+            return jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
 
         def bloom_add_kernel(words_loc, keys, mask):
             pos = bloom_positions(keys, params).astype(jnp.int32)
@@ -234,7 +333,7 @@ class ShardedSketchEngine:
             into the sharded HLL banks."""
             partial = local_contains(bits_loc, keys)
             # AND across sp: min-reduce of {0,1}.
-            valid = jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
+            valid = and_sp(partial)
             new_regs = hll_add_local(
                 regs_loc, jnp.where(valid, bank_idx, -1), keys, mask)
             return (host_readable(valid), new_regs,
@@ -259,19 +358,18 @@ class ShardedSketchEngine:
                                      banks_u.astype(jnp.int32))
                 mask = bank_idx >= 0
                 partial = local_contains(bits_loc, keys)
-                valid = jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
+                valid = and_sp(partial)
                 new_regs = hll_add_local(
                     regs_loc, jnp.where(valid, bank_idx, -1), keys, mask)
                 return (host_readable(valid), new_regs,
                         bump_local(counts_loc, valid, mask))
 
-            return jax.jit(jax.shard_map(
-                step_words_kernel, mesh=mesh,
-                in_specs=(P("sp"), P("dp", None, "sp"), counts_spec,
-                          P("dp")),
-                out_specs=(valid_spec, P("dp", None, "sp"), counts_spec),
-                check_vma=False),
-                donate_argnums=(1, 2))
+            return wrap(step_words_kernel,
+                        in_specs=(P("sp"), P("dp", None, "sp"),
+                                  counts_spec, P("dp")),
+                        out_specs=(valid_spec, P("dp", None, "sp"),
+                                   counts_spec),
+                        donate_argnums=(1, 2))
 
         self._make_step_words = make_step_words
 
@@ -293,25 +391,24 @@ class ShardedSketchEngine:
                 keys, bank_idx, real = decode(buf_loc[0], width,
                                               padded_local, nbanks)
                 partial = local_contains(bits_loc, keys)
-                valid = jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
+                valid = and_sp(partial)
                 new_regs = hll_add_local(
                     regs_loc, jnp.where(valid, bank_idx, -1), keys, real)
                 return (host_readable(valid), new_regs,
                         bump_local(counts_loc, valid, real))
 
-            return jax.jit(jax.shard_map(
-                step_narrow_kernel, mesh=mesh,
-                in_specs=(P("sp"), P("dp", None, "sp"), counts_spec,
-                          P("dp", None)),
-                out_specs=(valid_spec, P("dp", None, "sp"), counts_spec),
-                check_vma=False),
-                donate_argnums=(1, 2))
+            return wrap(step_narrow_kernel,
+                        in_specs=(P("sp"), P("dp", None, "sp"),
+                                  counts_spec, P("dp", None)),
+                        out_specs=(valid_spec, P("dp", None, "sp"),
+                                   counts_spec),
+                        donate_argnums=(1, 2))
 
         self._make_step_narrow = make_step_narrow
 
         def query_kernel(bits_loc, keys):
             partial = local_contains(bits_loc, keys)
-            valid = jax.lax.pmin(partial.astype(jnp.int32), "sp") == 1
+            valid = and_sp(partial)
             # contains() is a host-read API: gather the dp-sharded
             # answer so the output is fully replicated — on a
             # multi-host mesh a dp-sharded output would span
@@ -344,7 +441,18 @@ class ShardedSketchEngine:
                 bank.astype(jnp.int32), length=q + 2))(merged)
             return jax.lax.psum(hist, "sp")
 
-        smap = functools.partial(jax.shard_map, mesh=mesh)
+        # ONE wrapper for every kernel: shard_map + jit normally, plain
+        # jit in the degenerate single-device case (specs are then
+        # irrelevant — every array is whole). check_vma=False
+        # throughout: the collectives leave every device with values
+        # the static varying-axes checker cannot infer (all_gather+OR
+        # union filters, pmin + tiled all_gather replication, psum of
+        # dp-replicated popcounts).
+        def wrap(fn, in_specs, out_specs, donate_argnums=()):
+            return jax.jit(jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False), donate_argnums=donate_argnums)
+
         # Device-side replica merge for host reads: ships 1x the
         # register state over the host link instead of all dp private
         # copies (D2H volume is the expensive resource — see the
@@ -355,40 +463,25 @@ class ShardedSketchEngine:
         self._merge_regs = jax.jit(
             lambda r: jnp.max(r, axis=0),
             out_shardings=NamedSharding(mesh, P(None, None)))
-        # check_vma=False: the all_gather+OR leaves every dp replica with
-        # the identical union filter, but the static varying-axes checker
-        # cannot infer that replication through the elementwise ORs.
-        self._preload = jax.jit(jax.shard_map(
-            bloom_add_kernel, mesh=mesh,
-            in_specs=(P("sp"), P("dp"), P("dp")),
-            out_specs=P("sp"), check_vma=False),
-            donate_argnums=(0,))
-        regs_spec = P("dp", None, "sp")
-        self._step = jax.jit(smap(
-            step_kernel,
-            in_specs=(P("sp"), regs_spec, counts_spec, P("dp"), P("dp"),
-                      P("dp")),
-            out_specs=(valid_spec, regs_spec, counts_spec),
-            check_vma=False),
-            donate_argnums=(1, 2))
-        # Replicates the per-replica counter blocks so they are host-
-        # readable on a multi-host mesh (dp spans processes there).
+        # Replicates the per-replica counter blocks so they are
+        # host-readable on a multi-host mesh (dp spans processes).
         self._read_counts = jax.jit(
             lambda c: c, out_shardings=NamedSharding(mesh, P(None)))
-        # check_vma=False: like the preload's all_gather+OR, the static
-        # varying-axes checker cannot infer that pmin + tiled all_gather
-        # leave every device with the identical vector.
-        self._query = jax.jit(smap(
-            query_kernel, in_specs=(P("sp"), P("dp")),
-            out_specs=P(None), check_vma=False))
-        self._hist = jax.jit(smap(
-            hist_kernel, in_specs=(regs_spec,), out_specs=P(None)))
-        # check_vma=False: psum over "sp" leaves every device with the
-        # identical scalar (the filter is dp-replicated), but the
-        # static checker cannot infer that through the popcount sum.
-        self._fill = jax.jit(smap(
-            fill_kernel, in_specs=(P("sp"),), out_specs=P(),
-            check_vma=False))
+        self._preload = wrap(bloom_add_kernel,
+                             in_specs=(P("sp"), P("dp"), P("dp")),
+                             out_specs=P("sp"), donate_argnums=(0,))
+        regs_spec = P("dp", None, "sp")
+        self._step = wrap(step_kernel,
+                          in_specs=(P("sp"), regs_spec, counts_spec,
+                                    P("dp"), P("dp"), P("dp")),
+                          out_specs=(valid_spec, regs_spec, counts_spec),
+                          donate_argnums=(1, 2))
+        self._query = wrap(query_kernel, in_specs=(P("sp"), P("dp")),
+                           out_specs=P(None))
+        self._hist = wrap(hist_kernel, in_specs=(regs_spec,),
+                          out_specs=P(None))
+        self._fill = wrap(fill_kernel, in_specs=(P("sp"),),
+                          out_specs=P())
 
     # -- padded batch helpers ------------------------------------------------
     def padded_size(self, n: int) -> int:
@@ -504,8 +597,7 @@ class ShardedSketchEngine:
         this is exact on any mesh shape."""
         tiled = np.zeros((self.dp, 2, 2), np.uint32)
         tiled[0] = np.asarray(counts, dtype=np.uint32).reshape(2, 2)
-        self.counts = jax.device_put(
-            tiled, NamedSharding(self.mesh, P("dp")))
+        self.counts = self._put(tiled, P("dp"))
 
     def contains(self, keys) -> np.ndarray:
         keys = np.asarray(keys, dtype=np.uint32)
@@ -518,9 +610,7 @@ class ShardedSketchEngine:
         and dp-times cheaper to ship than tiling every replica."""
         tiled = np.zeros((self.dp,) + merged.shape, np.uint8)
         tiled[0] = merged
-        self.regs = jax.device_put(
-            jnp.asarray(tiled),
-            NamedSharding(self.mesh, P("dp", None, "sp")))
+        self.regs = self._put(jnp.asarray(tiled), P("dp", None, "sp"))
 
     def grow_banks(self, new_num_banks: int) -> None:
         """Double-style bank growth (rare; one host round-trip + reshard)."""
@@ -554,8 +644,7 @@ class ShardedSketchEngine:
         padded = np.zeros(self.m_words, dtype=np.uint32)
         padded[:real_words] = bits
         self.num_banks = regs.shape[0]
-        self.bits = jax.device_put(
-            jnp.asarray(padded), NamedSharding(self.mesh, P("sp")))
+        self.bits = self._put(jnp.asarray(padded), P("sp"))
         self._put_merged_regs(np.asarray(regs, dtype=np.uint8))
 
     def fill_fraction(self) -> float:

@@ -229,9 +229,10 @@ class _Subscription:
         with self.cond:
             entry = self.chunk_inflight.pop(chunk_id, None)
             if entry is not None:
-                self._append_block(
-                    [(mid, data, red + 1) for mid, data, red in entry[0]])
-                self._notify_if_waiting()
+                requeued = [(mid, data, red + 1)
+                            for mid, data, red in entry[0]]
+                self._append_block(requeued)
+                self._notify_if_waiting(len(requeued))
 
     def explode_chunk(self, chunk_id: int) -> None:
         """Convert a chunk's messages into ordinary per-message

@@ -406,16 +406,14 @@ def bench_sharded_step(batch_size: int, seconds: float, capacity: int,
     the mesh is (dp=1, sp=1) and device-resident pre-staged word
     buffers isolate the step itself.
 
-    TUNNEL CAVEAT (measured r03, PARITY.md): on the relay-tunneled
-    single chip, merely COMPILING/loading this engine's mesh
-    executable flips the whole process into ~80ms-per-dispatch
-    synchronous mode (~2000x; a hand-compiled equivalent of the same
-    shard_map kernel — same specs, donation, counts — does NOT trigger
-    it and runs 0.04ms/step). The number this mode records on a
-    tunneled chip is therefore a platform pathology floor, not the
-    machinery cost; pods without the tunnel and the virtual CPU mesh
-    are unaffected. Kept because recording the pathology beats
-    recording nothing."""
+    On relay-tunneled single chips, SPMD-partitioned executables load
+    into a ~2000x degraded execution path (r03 recorded 14.1M ev/s
+    here; full r04 forensics in PARITY.md "Sharded step on the
+    tunneled chip"). The engine's degenerate-mesh specialization
+    (parallel.sharded._build_single_kernels) compiles the (1,1) case
+    through the single-chip kernel suite instead — same math by
+    construction, no partitioner — which this mode now measures at the
+    plain fused step's class (r04: 14.8B ev/s)."""
     from attendance_tpu.models.fused import pack_words
     from attendance_tpu.parallel.sharded import (
         ShardedSketchEngine, make_mesh)
@@ -424,15 +422,30 @@ def bench_sharded_step(batch_size: int, seconds: float, capacity: int,
     engine = ShardedSketchEngine(mesh, capacity=capacity, error_rate=0.01,
                                  num_banks=num_banks, layout="blocked")
     rng = np.random.default_rng(0)
-    roster = _make_roster(rng, capacity)
+    # The key width must leave a bank field holding num_banks plus the
+    # padding sentinel (kw=31 would alias half the bank ids onto the
+    # sentinel and silently drop those lanes from the HLL/counters —
+    # r04 fix; the numpy pack now refuses that). The roster id space is
+    # half the kw-bit space (the other half is the disjoint negative
+    # population), widened as --capacity demands.
+    kw_max = 32 - (num_banks + 1).bit_length()
+    kw = max(24, min(kw_max, (2 * capacity - 1).bit_length() + 1))
+    if capacity > 1 << (kw - 1):
+        raise SystemExit(
+            f"--capacity {capacity} needs more than {kw - 1} id bits, "
+            f"but {num_banks} banks leave at most kw={kw_max} "
+            f"({1 << (kw_max - 1)} ids) on the word wire")
+    roster = rng.choice(1 << (kw - 1), size=capacity, replace=False
+                        ).astype(np.uint32)
     engine.preload(roster)
-    kw = 31  # roster ids span the full uint31 range
     padded = engine.padded_size(batch_size)
     bufs = []
     for _ in range(8):
+        # 50% members, 50% from the disjoint upper half of the kw-bit
+        # id space (the intended negative population).
         keys = np.where(rng.random(batch_size) < 0.5,
                         rng.choice(roster, batch_size),
-                        rng.integers(1 << 31, 1 << 32, batch_size,
+                        rng.integers(1 << (kw - 1), 1 << kw, batch_size,
                                      dtype=np.uint32)).astype(np.uint32)
         banks = rng.integers(0, num_banks, batch_size, dtype=np.uint32)
         bufs.append(jax.device_put(

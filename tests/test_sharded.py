@@ -206,3 +206,78 @@ def test_replica_sync_cross_mode_restore():
     for bank in range(4):
         assert dst.count(bank) == src.count(bank)
     assert np.asarray(dst.contains(keys)).all()
+
+
+def test_single_device_mesh_delegates_bit_identically():
+    """The (1,1) mesh compiles the single-chip kernel suite behind the
+    engine surface (parallel.sharded._build_single_kernels — the
+    tunneled-chip fix, PARITY.md r04 forensics). Every wire, query and
+    snapshot answer must be bit-identical to the shard_map build on a
+    multi-device mesh."""
+    from attendance_tpu.models.fused import (
+        delta_scan, pack_delta, pack_seg, pack_words, pick_delta_width)
+
+    single = engine(1, 1)
+    multi = engine(2, 4)
+    assert single.single and not multi.single
+    roster = np.arange(30_000, 38_000, dtype=np.uint32)
+    single.preload(roster)
+    multi.preload(roster)
+
+    rng = np.random.default_rng(3)
+    n = 2_048
+    keys = np.where(rng.random(n) < 0.7, rng.choice(roster, n),
+                    rng.integers(1 << 20, 1 << 21, n)).astype(np.uint32)
+    banks = rng.integers(0, 8, n).astype(np.uint32)
+
+    # word wire
+    kw = 17
+    for eng in (single, multi):
+        words = pack_words(keys, banks, kw, eng.padded_size(n))
+        v = eng.step_words(words, n, kw)
+        np.testing.assert_array_equal(
+            np.asarray(v), np.isin(keys, roster) | np.asarray(v))
+    # seg + delta wires (per-replica packed: dp=1 single, dp=2 multi)
+    for mode in ("seg", "delta"):
+        for eng in (single, multi):
+            dp = eng.dp
+            pl = eng.padded_size(n) // dp
+            bounds = [min(n, r * pl) for r in range(dp + 1)]
+            if mode == "seg":
+                width = 21
+                packs = [pack_seg(keys[bounds[r]:bounds[r + 1]],
+                                  banks[bounds[r]:bounds[r + 1]],
+                                  width, pl, 8) for r in range(dp)]
+            else:
+                scans = [delta_scan(keys[bounds[r]:bounds[r + 1]],
+                                    banks[bounds[r]:bounds[r + 1]], 8)
+                         for r in range(dp)]
+                width = pick_delta_width(1, max(s[-1] for s in scans))
+                packs = [pack_delta(keys[bounds[r]:bounds[r + 1]],
+                                    banks[bounds[r]:bounds[r + 1]],
+                                    width, pl, 8, scan=scans[r])
+                         for r in range(dp)]
+            bufs = np.stack([p[0] for p in packs])
+            eng.step_narrow(bufs, mode, width, pl)
+
+    # Identical answers on every query surface.
+    probe = np.concatenate([roster[:1000],
+                            np.arange(1 << 22, (1 << 22) + 1000,
+                                      dtype=np.uint32)])
+    np.testing.assert_array_equal(single.contains(probe),
+                                  multi.contains(probe))
+    np.testing.assert_array_equal(single.count_all(), multi.count_all())
+    assert single.validity_counts() == multi.validity_counts()
+    assert single.fill_fraction() == pytest.approx(
+        multi.fill_fraction(), rel=1e-6)
+    b1, r1 = single.get_state()
+    b2, r2 = multi.get_state()
+    np.testing.assert_array_equal(b1, b2)
+    np.testing.assert_array_equal(r1, r2)
+
+    # Snapshot round-trip across the two builds restores exactly.
+    fresh = engine(1, 1)
+    fresh.set_state(b2, r2)
+    fresh.set_counts(multi.get_counts())
+    np.testing.assert_array_equal(fresh.get_state()[0], b2)
+    assert fresh.validity_counts() == multi.validity_counts()
