@@ -42,6 +42,11 @@ _OP_ACK_IDS = 4
 _OP_NACK = 5
 _OP_BACKLOG = 6
 _OP_CLOSE_CONSUMER = 7
+_OP_PRODUCE_MANY = 8
+_OP_RECEIVE_CHUNK = 9
+_OP_ACK_CHUNK = 10
+_OP_NACK_CHUNK = 11
+_OP_EXPLODE_CHUNK = 12
 
 _ST_OK = 0
 _ST_TIMEOUT = 1
@@ -188,20 +193,51 @@ class BrokerServer:
                 self._consumer_counts[key] = (
                     self._consumer_counts.get(key, 0) + 1)
             return _ST_OK, struct.pack("<I", handle)
-        if op == _OP_RECEIVE:
+        if op == _OP_PRODUCE_MANY:
+            (tlen,) = struct.unpack_from("<H", body)
+            topic = body[2:2 + tlen].decode()
+            off = 2 + tlen
+            (count,) = struct.unpack_from("<I", body, off)
+            off += 4
+            datas = []
+            for _ in range(count):
+                (dlen,) = struct.unpack_from("<I", body, off)
+                off += 4
+                datas.append(body[off:off + dlen])
+                off += dlen
+            first = self.broker.topic(topic).publish_many(datas)
+            return _ST_OK, struct.pack("<q", first)
+        if op in (_OP_RECEIVE, _OP_RECEIVE_CHUNK):
             handle, max_n, timeout_ms = struct.unpack("<IIi", body)
             consumer = consumers[handle][0]
             timeout_ms = min(timeout_ms, _MAX_WAIT_MS)
             try:
-                msgs = consumer.receive_many_raw(
-                    max_n, timeout_millis=timeout_ms)
+                if op == _OP_RECEIVE_CHUNK:
+                    cid, msgs = consumer.receive_chunk(
+                        max_n, timeout_millis=timeout_ms)
+                else:
+                    cid = 0
+                    msgs = consumer.receive_many_raw(
+                        max_n, timeout_millis=timeout_ms)
             except ReceiveTimeout:
                 return _ST_TIMEOUT, b""
-            parts = [struct.pack("<I", len(msgs))]
+            parts = [struct.pack("<QI", cid, len(msgs))]
             for mid, data, red in msgs:
                 parts.append(struct.pack("<QII", mid, red, len(data)))
                 parts.append(data)
             return _ST_OK, b"".join(parts)
+        if op == _OP_ACK_CHUNK:
+            handle, cid = struct.unpack("<IQ", body)
+            consumers[handle][0].acknowledge_chunk(cid)
+            return _ST_OK, b""
+        if op == _OP_NACK_CHUNK:
+            handle, cid = struct.unpack("<IQ", body)
+            consumers[handle][0].nack_chunk(cid)
+            return _ST_OK, b""
+        if op == _OP_EXPLODE_CHUNK:
+            handle, cid = struct.unpack("<IQ", body)
+            consumers[handle][0].explode_chunk(cid)
+            return _ST_OK, b""
         if op == _OP_ACK_IDS:
             handle, n = struct.unpack_from("<II", body)
             mids = struct.unpack_from(f"<{n}Q", body, 8)
@@ -276,6 +312,21 @@ class SocketProducer:
         (mid,) = struct.unpack("<Q", _check(status, reply))
         return mid
 
+    def send_many(self, datas) -> int:
+        """Bulk send: ONE round-trip and one broker pass for the whole
+        batch (mirrors the memory producer's send_many; callers
+        feature-detect). Returns the first assigned id."""
+        if self._closed:
+            raise RuntimeError("producer closed")
+        datas = [bytes(d) for d in datas]
+        parts = [self._prefix, struct.pack("<I", len(datas))]
+        for d in datas:
+            parts.append(struct.pack("<I", len(d)))
+            parts.append(d)
+        status, reply = self._rpc.call(_OP_PRODUCE_MANY, b"".join(parts))
+        (first,) = struct.unpack("<q", _check(status, reply))
+        return first
+
     def flush(self) -> None:
         pass
 
@@ -293,8 +344,8 @@ class SocketConsumer:
         self._handle = handle
         self._closed = False
 
-    def receive_many_raw(self, max_n: int,
-                         timeout_millis: Optional[int] = None) -> list:
+    def _receive_op(self, op: int, max_n: int,
+                    timeout_millis: Optional[int]):
         if self._closed:
             raise RuntimeError("consumer closed")
         import time as _time
@@ -305,27 +356,52 @@ class SocketConsumer:
                     else _time.monotonic() + timeout_millis / 1e3)
         while True:
             if deadline is None:
-                chunk = _MAX_WAIT_MS
+                wait = _MAX_WAIT_MS
             else:
                 rem_ms = int((deadline - _time.monotonic()) * 1000)
                 if rem_ms <= 0:
                     raise ReceiveTimeout(
                         f"no message within {timeout_millis}ms")
-                chunk = min(rem_ms, _MAX_WAIT_MS)
+                wait = min(rem_ms, _MAX_WAIT_MS)
             status, reply = self._rpc.call(
-                _OP_RECEIVE, struct.pack("<IIi", self._handle, max_n,
-                                         int(chunk)))
+                op, struct.pack("<IIi", self._handle, max_n, int(wait)))
             if status == _ST_TIMEOUT:
                 continue  # deadline not reached yet: wait again
             body = _check(status, reply)
-            (count,) = struct.unpack_from("<I", body)
-            out, off = [], 4
+            cid, count = struct.unpack_from("<QI", body)
+            out, off = [], 12
             for _ in range(count):
                 mid, red, dlen = struct.unpack_from("<QII", body, off)
                 off += 16
                 out.append((mid, body[off:off + dlen], red))
                 off += dlen
-            return out
+            return cid, out
+
+    def receive_many_raw(self, max_n: int,
+                         timeout_millis: Optional[int] = None) -> list:
+        return self._receive_op(_OP_RECEIVE, max_n, timeout_millis)[1]
+
+    def receive_chunk(self, max_n: int,
+                      timeout_millis: Optional[int] = None
+                      ) -> Tuple[int, list]:
+        """Chunk-lane batch receive over the wire: one server-side
+        in-flight entry for the whole batch, settled with
+        acknowledge_chunk / nack_chunk / explode_chunk — the bridge's
+        feature-detected fast lane works identically cross-process."""
+        return self._receive_op(_OP_RECEIVE_CHUNK, max_n, timeout_millis)
+
+    def acknowledge_chunk(self, chunk_id: int) -> None:
+        _check(*self._rpc.call(
+            _OP_ACK_CHUNK, struct.pack("<IQ", self._handle, chunk_id)))
+
+    def nack_chunk(self, chunk_id: int) -> None:
+        _check(*self._rpc.call(
+            _OP_NACK_CHUNK, struct.pack("<IQ", self._handle, chunk_id)))
+
+    def explode_chunk(self, chunk_id: int) -> None:
+        _check(*self._rpc.call(
+            _OP_EXPLODE_CHUNK, struct.pack("<IQ", self._handle,
+                                           chunk_id)))
 
     def receive_many(self, max_n: int,
                      timeout_millis: Optional[int] = None) -> list:

@@ -429,7 +429,7 @@ def bench_sharded_step(batch_size: int, seconds: float, capacity: int,
     # half the kw-bit space (the other half is the disjoint negative
     # population), widened as --capacity demands.
     kw_max = 32 - (num_banks + 1).bit_length()
-    kw = max(24, min(kw_max, (2 * capacity - 1).bit_length() + 1))
+    kw = min(kw_max, max(24, (2 * capacity - 1).bit_length() + 1))
     if capacity > 1 << (kw - 1):
         raise SystemExit(
             f"--capacity {capacity} needs more than {kw - 1} id bits, "
