@@ -32,7 +32,9 @@ def main() -> None:
     from attendance_tpu.transport.socket_broker import SocketClient
 
     config = Config(transport_backend="socket", socket_broker=addr,
-                    batch_size=256, batch_timeout_s=0.02)
+                    batch_size=int(os.environ.get("ATP_BRIDGE_BATCH",
+                                                  "256")),
+                    batch_timeout_s=0.02)
     bridge = JsonBinaryBridge(config, client=SocketClient(addr))
     bridge.run(idle_timeout_s=idle_s)
     with open(out_path, "w") as f:
