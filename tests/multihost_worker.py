@@ -101,6 +101,58 @@ def run_workload(mesh) -> dict:
     }
 
 
+def run_pipeline_workload(mesh) -> dict:
+    """The FULL FusedPipeline on the mesh — broker frames in, sharded
+    engine dispatch, columnar store writes OUT (the host-materialized
+    validity that requires the multi-process all_gather in the step
+    kernels — ADVICE r03: store writes used to require a
+    single-process mesh). Multi-controller convention: every process
+    feeds the identical deterministic frame stream and runs the
+    identical lockstep of collective step calls; the wire format is
+    pinned (auto mode adapts from TIMING, which would diverge across
+    processes and deadlock the collectives)."""
+    import hashlib
+
+    import numpy as np
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(bloom_filter_capacity=20_000,
+                    transport_backend="memory",
+                    num_shards=mesh.shape["sp"],
+                    num_replicas=mesh.shape["dp"],
+                    wire_format="word")
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8, mesh=mesh)
+    num_events, batch = 8_192, 2_048
+    roster, frames = generate_frames(num_events, batch,
+                                     roster_size=8_000, num_lectures=8,
+                                     invalid_fraction=0.2, seed=71)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=num_events, idle_timeout_s=1.0)
+
+    df = pipe.store.to_dataframe(deduplicate=False).sort_values(
+        ["micros", "student_id"])
+    # string keys: the worker's answers round-trip through JSON
+    counts = {str(d): int(pipe.count(d)) for d in pipe.lecture_days()}
+    vc = pipe.validity_counts()
+    return {
+        "pipe_events": pipe.metrics.events,
+        "pipe_valid_sha": hashlib.sha256(
+            np.packbits(df.is_valid.to_numpy(bool)).tobytes()
+        ).hexdigest(),
+        "pipe_counts": counts,
+        "pipe_validity_counts": list(vc),
+    }
+
+
 def main() -> None:
     proc_id, num_procs = int(sys.argv[1]), int(sys.argv[2])
     port, out_path = sys.argv[3], sys.argv[4]
@@ -133,6 +185,7 @@ def main() -> None:
         pass
 
     result = run_workload(mesh)
+    result.update(run_pipeline_workload(mesh))
     result["process_id"] = proc_id
     result["process_count"] = jax.process_count()
     with open(out_path, "w") as f:

@@ -93,13 +93,16 @@ def test_two_process_dcn_cluster_matches_single_process(tmp_path):
 
     # Single-process reference: same workload, same (dp=2, sp=4) mesh
     # shape, on this process's virtual 8-device CPU backend.
-    from multihost_worker import run_workload
+    from multihost_worker import run_pipeline_workload, run_workload
     ref = run_workload(make_multihost_mesh(num_shards=4))
+    ref.update(run_pipeline_workload(make_multihost_mesh(num_shards=4)))
 
     for r in results:
         for key in ("nvalid_total", "total", "counts", "exact",
                     "member_roster", "member_invalid", "bloom_sha",
-                    "regs_sha", "valid_sha"):
+                    "regs_sha", "valid_sha", "pipe_events",
+                    "pipe_valid_sha", "pipe_counts",
+                    "pipe_validity_counts"):
             assert r[key] == ref[key], (key, r[key], ref[key])
 
     # Sanity on the shared answer itself: complete roster membership
