@@ -30,6 +30,8 @@ _tried = False
 class PreparedJsonBatch:
     """Concatenated payload buffer + offset/length tables + output
     columns for the resumable JSON scan (HostPipe.parse_json_from).
+    The CPython-API list scan reads payloads in place, so its output
+    holders (HostPipe.empty_json_outputs) carry buf/offs/lens = None.
 
     Layout note: a zero-copy pointer-array variant (ctypes c_char_p
     array into the payload bytes) was measured 3x SLOWER to set up

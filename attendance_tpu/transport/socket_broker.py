@@ -28,6 +28,7 @@ import logging
 import socket
 import struct
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from attendance_tpu.transport.memory_broker import (
@@ -348,17 +349,15 @@ class SocketConsumer:
                     timeout_millis: Optional[int]):
         if self._closed:
             raise RuntimeError("consumer closed")
-        import time as _time
-
         # The server bounds one blocking wait at _MAX_WAIT_MS, so both
         # long and absent timeouts are chunked client-side.
         deadline = (None if timeout_millis is None
-                    else _time.monotonic() + timeout_millis / 1e3)
+                    else time.monotonic() + timeout_millis / 1e3)
         while True:
             if deadline is None:
                 wait = _MAX_WAIT_MS
             else:
-                rem_ms = int((deadline - _time.monotonic()) * 1000)
+                rem_ms = int((deadline - time.monotonic()) * 1000)
                 if rem_ms <= 0:
                     raise ReceiveTimeout(
                         f"no message within {timeout_millis}ms")
@@ -471,7 +470,6 @@ def main(argv=None) -> None:
     the Config.socket_broker default; ``--port 0`` for an ephemeral
     port, printed on startup)."""
     import argparse
-    import time
 
     p = argparse.ArgumentParser(description="attendance_tpu socket broker")
     p.add_argument("--host", default="127.0.0.1")

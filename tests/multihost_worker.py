@@ -111,8 +111,6 @@ def run_pipeline_workload(mesh) -> dict:
     identical lockstep of collective step calls; the wire format is
     pinned (auto mode adapts from TIMING, which would diverge across
     processes and deadlock the collectives)."""
-    import hashlib
-
     import numpy as np
 
     from attendance_tpu.config import Config
