@@ -15,6 +15,8 @@ makes every pytest process after the first start warm.
 
 import os
 
+import pytest
+
 _CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
 
 import jax  # noqa: E402
@@ -24,3 +26,14 @@ jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+@pytest.fixture
+def server():
+    """A live BrokerServer for socket-transport tests (one lifecycle
+    definition for the transport suite and the CLI smoke tests)."""
+    from attendance_tpu.transport.socket_broker import BrokerServer
+
+    srv = BrokerServer().start()
+    yield srv
+    srv.stop()

@@ -198,3 +198,18 @@ def test_stats_requires_lecture_or_student():
     with pytest.raises(SystemExit) as e:
         main(["stats"])
     assert e.value.code == 2
+
+
+def test_pipeline_subcommand_socket_backend(server, capsys):
+    """--transport-backend=socket drives the whole pipeline subcommand
+    through the framework's own cross-process broker: generator and
+    processor each dial the server over TCP, sharing topics through it
+    instead of an in-process object."""
+    main(["pipeline", "--sketch-backend", "memory",
+          "--transport-backend", "socket",
+          "--socket-broker", server.address,
+          "--num-students", "40", "--num-invalid", "5",
+          "--seed", "3", "--batch-size", "128"])
+    out = capsys.readouterr().out
+    assert "Habitual Latecomers" in out
+    assert "Invalid Attendance Attempts" in out

@@ -21,13 +21,6 @@ from attendance_tpu.transport.socket_broker import (
     BrokerServer, SocketClient)
 
 
-@pytest.fixture
-def server():
-    srv = BrokerServer().start()
-    yield srv
-    srv.stop()
-
-
 def test_socket_produce_consume_ack_nack(server):
     client = SocketClient(server.address)
     producer = client.create_producer("t")
