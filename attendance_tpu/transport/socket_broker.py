@@ -145,7 +145,10 @@ class BrokerServer:
         # handle -> (MemoryConsumer, topic, subscription) owned by THIS
         # connection; a dropped connection requeues exactly these.
         consumers: Dict[int, tuple] = {}
-        next_handle = 0
+        # Handles cross the wire as u32; exhausting the range surfaces
+        # as a protocol error from alloc() BEFORE any registration, not
+        # a struct.error after it.
+        handle_counter = iter(range(1 << 32))
         try:
             while True:
                 try:
@@ -155,12 +158,15 @@ class BrokerServer:
                 try:
                     status, reply = self._handle(
                         op, body, consumers,
-                        alloc=lambda: next_handle)
-                    if op == _OP_SUBSCRIBE and status == _ST_OK:
-                        next_handle += 1
+                        alloc=lambda: next(handle_counter))
                 except Exception as exc:  # protocol keeps flowing
                     status, reply = _ST_ERROR, repr(exc).encode()
-                _send_frame(conn, status, reply)
+                try:
+                    _send_frame(conn, status, reply)
+                except (ConnectionError, OSError):
+                    # Peer dropped mid-reply (fast client teardown
+                    # severs connections abruptly): normal shutdown.
+                    break
         finally:
             conn.close()
             # Cross-process crash takeover: close every consumer this
@@ -187,6 +193,11 @@ class BrokerServer:
                 MemoryConsumer)
             consumer = MemoryConsumer(
                 self.broker.topic(topic).subscription(sub))
+            # Allocate the handle only once the consumer exists, and
+            # consume it in the same expression that registers the
+            # entry: a fresh handle per alloc() means a partially
+            # completed subscribe can never hand its handle to the next
+            # one and orphan a registered consumer's inflight messages.
             handle = alloc()
             consumers[handle] = (consumer, topic, sub)
             with self._lock:
@@ -266,9 +277,12 @@ class BrokerServer:
 
 
 class _Rpc:
-    """One synchronous request/reply channel to the server (shared by a
-    client's producers and consumers under a lock — callers alternate
-    drain/publish anyway, and batching keeps round-trips rare)."""
+    """One synchronous request/reply channel to the server. A client's
+    producers share the client channel under the lock (their calls are
+    short round-trips); each consumer gets a DEDICATED channel, because
+    a blocking receive holds its channel for up to a full server wait
+    round (~10s) and must not stall producers or sibling consumers used
+    from other threads of the same client."""
 
     def __init__(self, address: str):
         host, port = address.rsplit(":", 1)
@@ -285,7 +299,25 @@ class _Rpc:
             _send_frame(self._sock, op, body)
             return _recv_frame(self._sock)
 
+    def try_call(self, op: int, body: bytes
+                 ) -> Optional[Tuple[int, bytes]]:
+        """call(), but None instead of waiting when another thread
+        holds the channel (e.g. parked in a blocking receive)."""
+        if not self._lock.acquire(blocking=False):
+            return None
+        try:
+            _send_frame(self._sock, op, body)
+            return _recv_frame(self._sock)
+        finally:
+            self._lock.release()
+
     def close(self) -> None:
+        # shutdown() first so a thread parked in recv() on this channel
+        # wakes immediately instead of waiting out the server round.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -340,9 +372,12 @@ class SocketConsumer:
     including the zero-wrapper raw lane (the bridge feature-detects
     receive_many_raw) and batch acks."""
 
-    def __init__(self, rpc: _Rpc, handle: int):
+    def __init__(self, rpc: _Rpc, handle: int, owns_rpc: bool = False,
+                 owner: "Optional[SocketClient]" = None):
         self._rpc = rpc
         self._handle = handle
+        self._owns_rpc = owns_rpc
+        self._owner = owner
         self._closed = False
 
     def _receive_op(self, op: int, max_n: int,
@@ -354,6 +389,11 @@ class SocketConsumer:
         deadline = (None if timeout_millis is None
                     else time.monotonic() + timeout_millis / 1e3)
         while True:
+            if self._closed:
+                # close()/client.close() from another thread between
+                # wait rounds: surface the clean shutdown signal, not
+                # the dead handle's server error.
+                raise RuntimeError("consumer closed")
             if deadline is None:
                 wait = _MAX_WAIT_MS
             else:
@@ -434,18 +474,53 @@ class SocketConsumer:
         (n,) = struct.unpack("<Q", _check(status, reply))
         return n
 
+    def _abort(self) -> None:
+        """Teardown without the graceful RPC: mark closed, sever the
+        owned connection (the server's connection-drop takeover
+        requeues unacked messages), deregister from the owner."""
+        self._closed = True
+        if self._owns_rpc:
+            self._rpc.close()
+        if self._owner is not None:
+            self._owner._consumers.discard(self)
+
     def close(self) -> None:
-        if not self._closed:
-            self._closed = True
-            _check(*self._rpc.call(
-                _OP_CLOSE_CONSUMER, struct.pack("<I", self._handle)))
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            # Graceful close-RPC only when the channel is free RIGHT
+            # NOW: a sibling thread parked in a blocking receive holds
+            # it for up to a full server wait round, and severing the
+            # connection below yields the same requeue semantics.
+            res = self._rpc.try_call(
+                _OP_CLOSE_CONSUMER, struct.pack("<I", self._handle))
+            if res is not None:
+                _check(*res)
+        except (ConnectionError, OSError):
+            # Broker already gone: its connection-drop takeover has
+            # (or will have) requeued this consumer's unacked
+            # messages; raising here would only mask the original
+            # failure in teardown paths.
+            pass
+        finally:
+            # The dedicated connection must close even when the broker
+            # replied with a protocol error (_ST_ERROR -> RuntimeError).
+            self._abort()
 
 
 class SocketClient:
-    """pulsar.Client call-shape against a BrokerServer address."""
+    """pulsar.Client call-shape against a BrokerServer address.
+
+    Producers share the client's channel; every consumer gets its own
+    TCP connection (see _Rpc), so threaded producer+consumer use works
+    like the memory broker's. Consumer connections are closed by
+    consumer.close() and swept by client.close()."""
 
     def __init__(self, address: str):
+        self._address = address
         self._rpc = _Rpc(address)
+        self._consumers: set = set()
 
     def create_producer(self, topic: str) -> SocketProducer:
         return SocketProducer(self._rpc, topic)
@@ -453,14 +528,29 @@ class SocketClient:
     def subscribe(self, topic: str, subscription_name: str,
                   consumer_type=None) -> SocketConsumer:
         del consumer_type  # shared semantics, like the memory broker
+        rpc = _Rpc(self._address)
         t, s = topic.encode(), subscription_name.encode()
         body = (struct.pack("<H", len(t)) + t
                 + struct.pack("<H", len(s)) + s)
-        status, reply = self._rpc.call(_OP_SUBSCRIBE, body)
-        (handle,) = struct.unpack("<I", _check(status, reply))
-        return SocketConsumer(self._rpc, handle)
+        try:
+            status, reply = rpc.call(_OP_SUBSCRIBE, body)
+            (handle,) = struct.unpack("<I", _check(status, reply))
+        except BaseException:
+            rpc.close()
+            raise
+        consumer = SocketConsumer(rpc, handle, owns_rpc=True, owner=self)
+        self._consumers.add(consumer)
+        return consumer
 
     def close(self) -> None:
+        # Fast teardown: sever every consumer's dedicated connection
+        # instead of the graceful close-RPC — the RPC would serialize
+        # behind any thread parked in a blocking receive, and the
+        # server's connection-drop takeover requeues unacked messages
+        # either way.
+        for consumer in list(self._consumers):
+            consumer._abort()
+        self._consumers.clear()
         self._rpc.close()
 
 

@@ -68,6 +68,49 @@ def test_make_client_socket_backend(server):
     client.close()
 
 
+def test_blocked_consumer_does_not_stall_producer(server):
+    """A consumer parked in a blocking receive holds only ITS dedicated
+    connection; a producer on the same client must complete immediately
+    (ADVICE r04: the shared-channel design serialized threaded clients
+    behind the consumer's up-to-10s server wait round)."""
+    import threading
+
+    client = SocketClient(server.address)
+    consumer = client.subscribe("t", "sub")
+    producer = client.create_producer("t")
+    got = []
+    th = threading.Thread(
+        target=lambda: got.extend(
+            consumer.receive_many(1, timeout_millis=8000)))
+    th.start()
+    time.sleep(0.3)  # let the consumer enter its blocking server wait
+    t0 = time.monotonic()
+    producer.send(b"hello")
+    assert time.monotonic() - t0 < 1.0, \
+        "producer stalled behind the blocked consumer's channel"
+    th.join(timeout=8)
+    assert [m.data() for m in got] == [b"hello"]
+    client.close()
+
+
+def test_consumer_close_quiet_when_broker_dead():
+    """consumer.close()/client.close() after the broker died must not
+    raise (ADVICE r04): the server's connection-drop takeover already
+    requeues unacked messages, and raising would mask the original
+    failure in teardown paths."""
+    server = BrokerServer().start()
+    client = SocketClient(server.address)
+    consumer = client.subscribe("t", "sub")
+    client.create_producer("t").send(b"x")
+    assert consumer.receive(timeout_millis=2000).data() == b"x"
+    server.stop()
+    # Sever the consumer's channel so the close-RPC genuinely fails
+    # (stop() alone only closes the listener; live connections linger).
+    consumer._rpc._sock.close()
+    consumer.close()  # no raise
+    client.close()  # no raise
+
+
 def test_crash_takeover_across_connections(server):
     """A dropped CONNECTION (process crash) requeues its consumers'
     unacked messages for surviving competitors — the Pulsar takeover
@@ -81,7 +124,10 @@ def test_crash_takeover_across_connections(server):
         producer.send(f"m{i}".encode())
     taken = cv.receive_many(2, timeout_millis=2000)
     assert len(taken) == 2
-    victim._rpc.close()  # simulate a crash: drop the TCP connection
+    # Simulate a crash: drop the victim's TCP connections (each
+    # consumer holds a dedicated one; a real process death drops all).
+    cv._rpc.close()
+    victim._rpc.close()
     deadline = time.monotonic() + 5
     got = []
     while len(got) < 4 and time.monotonic() < deadline:
