@@ -81,10 +81,37 @@ def _num_records(records) -> int:
 
 def _store_for_events_file(config, path: str):
     """Event store able to read ``path``, sniffing the saved format:
-    the fused pipeline's columnar snapshots are npz (zip magic), the
-    row stores save JSONL. Swaps the configured backend when the flag
-    disagrees with the file."""
+    the fused pipeline's incremental snapshots are a SEGMENT DIRECTORY
+    (fused_events_segs/segment-*.npz — accepted directly, as the
+    snapshot dir containing it, or via the legacy fused_events.npz
+    path it superseded), one-shot columnar saves are a single npz (zip
+    magic), and the row stores save JSONL. Swaps the configured
+    backend when the flag disagrees with the file."""
+    from pathlib import Path
+
+    from attendance_tpu.pipeline.fast_path import EVENTS_SEGMENTS
     from attendance_tpu.storage import make_event_store
+
+    p = Path(path)
+    seg_dir = None
+    if p.is_dir():
+        if list(p.glob("segment-*.npz")):
+            seg_dir = p
+        elif (p / EVENTS_SEGMENTS).is_dir():
+            seg_dir = p / EVENTS_SEGMENTS
+    elif (p.parent / EVENTS_SEGMENTS).is_dir():
+        # The legacy npz spelling resolves to the sibling segments dir
+        # even when the old file still EXISTS: a snapshot dir upgraded
+        # from the pre-segments format keeps writing new events to the
+        # segments only, so the stale npz must never shadow them.
+        seg_dir = p.parent / EVENTS_SEGMENTS
+    if seg_dir is not None:
+        from attendance_tpu.storage.columnar_store import (
+            ColumnarEventStore)
+
+        store = ColumnarEventStore()
+        store.load_segments(seg_dir)
+        return store
 
     store = make_event_store(config)
     with open(path, "rb") as f:

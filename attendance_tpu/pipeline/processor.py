@@ -60,6 +60,12 @@ class ProcessorMetrics:
     # adaptive ladder makes "which regime did this run measure" a real
     # observability question).
     wire_dwell: Dict[str, int] = field(default_factory=dict)
+    # Checkpointing observability (fused async writer): wall seconds of
+    # each background snapshot write, and how long the hot loop spent
+    # BLOCKED waiting for a busy writer (backpressure) — together they
+    # say what durability actually cost a run.
+    snapshot_stalls: List[float] = field(default_factory=list)
+    snapshot_blocked_s: float = 0.0
 
     @property
     def events_per_second(self) -> float:
@@ -86,6 +92,9 @@ class ProcessorMetrics:
             "estimated_fpr": estimated_fpr,
             "fpr_is_lower_bound": fpr_is_lower_bound,
             "wire_dwell": dict(self.wire_dwell),
+            "snapshots": len(self.snapshot_stalls),
+            "snapshot_write_s": round(sum(self.snapshot_stalls), 4),
+            "snapshot_blocked_s": round(self.snapshot_blocked_s, 4),
         }
 
     def write_json_line(self, path: str, **to_dict_kwargs) -> None:

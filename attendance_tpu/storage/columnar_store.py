@@ -35,6 +35,12 @@ class ColumnarEventStore:
     def __init__(self):
         self._blocks: List[Dict[str, np.ndarray]] = []
         self._lock = threading.Lock()
+        # save_segments watermark: blocks below this index are already
+        # durable in a segment file. The sequence number is never reset
+        # (not even by truncate) so segment filenames stay unique for
+        # the lifetime of a snapshot directory.
+        self._saved_blocks = 0
+        self._segment_seq = 0
         # Memoized compaction: read paths (analytics, per-lecture scans)
         # often run many queries against an unchanged store; the concat +
         # dedup lexsort is O(N log N) over ALL events, so it is computed
@@ -187,6 +193,95 @@ class ColumnarEventStore:
                 for day in self.distinct_lecture_days()]
 
     # -- durability ----------------------------------------------------------
+    def mark(self) -> int:
+        """Consistent-point watermark for async snapshots: the block
+        count RIGHT NOW. Pass it as ``upto`` to save_segments so a
+        background writer persists exactly the blocks that existed at
+        the barrier, while the hot path keeps appending."""
+        with self._lock:
+            return len(self._blocks)
+
+    def save_segments(self, dir_path, upto: "int | None" = None) -> int:
+        """Incremental durability for checkpoint cadences: write ONLY
+        the blocks appended since the previous ``save_segments`` call,
+        as one numbered segment file (atomic rename). ``save`` re-dedups
+        and rewrites the WHOLE store every call — O(total events) per
+        snapshot, quadratic over a run — where the append-only design
+        makes the increment sufficient: dedup already happens at read
+        time, so a restore that loads every segment in order reproduces
+        exactly the pre-crash append stream (rows from frames replayed
+        after a crash fold in through the same last-write-wins dedup,
+        mirroring Cassandra upsert semantics the reference relies on,
+        reference attendance_processor.py:116-124).
+
+        Device-resident validity lanes in the pending blocks are
+        materialized once, in place, so neither later saves nor read
+        paths re-fetch them from the device. Returns rows written."""
+        dir_path = Path(dir_path)
+        dir_path.mkdir(parents=True, exist_ok=True)
+        with self._lock:
+            end = len(self._blocks) if upto is None else upto
+            pending = self._blocks[self._saved_blocks:end]
+            if not pending:
+                return 0
+            self._saved_blocks = end
+            self._segment_seq += 1
+            seq = self._segment_seq
+        # Materialize outside the lock (these are D2H transfers for
+        # device-resident validity lanes — the async writer must not
+        # hold the hot path's insert lock through them); writing each
+        # host copy back into its block keeps every later read free.
+        # One batched device_get for ALL device-resident columns
+        # (validity lanes, in practice). Measured alternatives on the
+        # tunneled chip: per-array np.asarray pays a round-trip each;
+        # a device-side concat into one transfer recompiles per block
+        # count (multi-second stalls) and contends with the hot loop's
+        # dispatch stream — the plain batched fetch is the fastest
+        # that doesn't perturb the pipeline.
+        device_cols = [(block, name) for block in pending
+                       for name in _COLS
+                       if not isinstance(block[name], np.ndarray)]
+        if device_cols:
+            import jax
+
+            fetched = jax.device_get(
+                [block[name] for block, name in device_cols])
+            for (block, name), arr in zip(device_cols, fetched):
+                block[name] = np.asarray(arr)
+        for block in pending:
+            for name in _COLS:
+                block[name] = np.asarray(block[name])
+        cols = {name: np.concatenate([b[name] for b in pending])
+                for name in _COLS}
+        path = dir_path / f"segment-{seq:08d}.npz"
+        tmp = path.with_suffix(".tmp")
+        # Uncompressed: zlib costs ~40x the raw write on this one-core
+        # host (measured 0.6s vs 0.014s per 2^19-event segment) and the
+        # stall is on the ack-latency path; np.load reads either form.
+        with open(tmp, "wb") as f:
+            np.savez(f, **cols)
+        tmp.replace(path)
+        return len(cols["student_id"])
+
+    def load_segments(self, dir_path) -> int:
+        """Load every segment written by :meth:`save_segments`, in
+        write order; returns rows loaded. Marks the restored blocks as
+        already-durable (the next ``save_segments`` writes only NEW
+        blocks) and resumes the sequence past the highest on-disk
+        segment so later saves never collide with restored ones."""
+        dir_path = Path(dir_path)
+        if not dir_path.is_dir():
+            return 0
+        total = 0
+        last_seq = 0
+        for path in sorted(dir_path.glob("segment-*.npz")):
+            total += self.load(path)
+            last_seq = max(last_seq, int(path.stem.split("-")[1]))
+        with self._lock:
+            self._saved_blocks = len(self._blocks)
+            self._segment_seq = max(self._segment_seq, last_seq)
+        return total
+
     def save(self, path) -> None:
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
@@ -207,6 +302,7 @@ class ColumnarEventStore:
             self._compacted.clear()
             self._lid_of_day.clear()
             self._write_gen += 1
+            self._saved_blocks = 0  # _segment_seq stays monotonic
 
     def close(self) -> None:
         pass

@@ -45,10 +45,15 @@ def test_analyze_loads_columnar_events_file(tmp_path, capsys):
           "--num-lectures", "4", "--bloom-capacity", "20000",
           "--snapshot-dir", str(tmp_path)])
     capsys.readouterr()
-    main(["analyze", "--events-file", str(tmp_path / "fused_events.npz")])
-    out = capsys.readouterr().out
-    assert "Habitual Latecomers" in out
-    assert "Invalid Attendance Attempts" in out
+    # All three spellings of the incremental snapshot location: the
+    # legacy npz path (superseded by the sibling segments dir), the
+    # snapshot dir itself, and the segments dir directly.
+    for target in (tmp_path / "fused_events.npz", tmp_path,
+                   tmp_path / "fused_events_segs"):
+        main(["analyze", "--events-file", str(target)])
+        out = capsys.readouterr().out
+        assert "Habitual Latecomers" in out
+        assert "Invalid Attendance Attempts" in out
 
 
 def test_pipeline_subcommand_columnar_backend(capsys):
@@ -155,11 +160,14 @@ def test_stats_subcommand(tmp_path, capsys):
           "--snapshot-dir", str(tmp_path)])
     capsys.readouterr()
     import numpy as np
-    with np.load(tmp_path / "fused_events.npz") as d:
-        day = int(d["lecture_day"][0])
-        expect = int((d["lecture_day"] == day).sum())
-    # Default storage backend + npz file: the format sniff must swap to
-    # the columnar store (same contract as analyze --events-file).
+    segs = sorted((tmp_path / "fused_events_segs").glob("segment-*.npz"))
+    assert segs  # the fused snapshot now writes incremental segments
+    days = np.concatenate([np.load(p)["lecture_day"] for p in segs])
+    sids = np.concatenate([np.load(p)["student_id"] for p in segs])
+    day = int(days[0])
+    expect = int((days == day).sum())
+    # Default storage backend + the legacy npz path: the resolver must
+    # find the sibling segments dir (same contract as analyze).
     main(["stats", f"LECTURE_{day}", "--sketch-backend", "memory",
           "--events-file", str(tmp_path / "fused_events.npz")])
     out = capsys.readouterr().out
@@ -168,8 +176,7 @@ def test_stats_subcommand(tmp_path, capsys):
     # count must fall back to the exact per-partition distinct, never
     # print a silently-wrong zero next to a non-empty partition.
     assert "0 unique attendees" not in out
-    with np.load(tmp_path / "fused_events.npz") as d:
-        exact = len(np.unique(d["student_id"][d["lecture_day"] == day]))
+    exact = len(np.unique(sids[days == day]))
     assert f"{exact} unique attendees" in out
 
 
@@ -183,10 +190,11 @@ def test_stats_student_id(tmp_path, capsys):
     import json
 
     import numpy as np
-    data = np.load(tmp_path / "fused_events.npz")
+    seg = sorted((tmp_path / "fused_events_segs").glob("segment-*.npz"))[0]
+    data = np.load(seg)
     sid = int(np.asarray(data["student_id"])[0])
     main(["stats", "--student-id", str(sid),
-          "--events-file", str(tmp_path / "fused_events.npz")])
+          "--events-file", str(tmp_path / "fused_events_segs")])
     out = capsys.readouterr().out
     assert f"Student {sid}:" in out
     assert "attendance records" in out
