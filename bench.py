@@ -31,6 +31,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import time
 from pathlib import Path
 
@@ -40,6 +41,58 @@ import numpy as np
 
 NORTH_STAR_EVENTS_PER_SEC = 50e6  # v5e-8, BASELINE.json
 TARGET_CHIPS = 8
+
+# Converge-then-measure pass policy (VERDICT r04 #1): a blind
+# median-of-N lands mid-warmup when the early passes still carry
+# compile/cache/tunnel ramp — r04's recorded rate series all ramped
+# monotonically and the artifact under-read dedicated reruns by
+# 2-6.5x. Passes now repeat until the last CONVERGE_TAIL agree within
+# CONVERGE_TOL (capped), and the reported number is the median of that
+# converged tail, with per-pass rates/walls/loadavg recorded so a
+# non-converged artifact attributes itself.
+CONVERGE_TAIL = 3
+CONVERGE_TOL = 0.20
+CONVERGE_MAX_PASSES = 10
+
+
+def _tail_spread(rates) -> float:
+    tail = rates[-CONVERGE_TAIL:]
+    return max(tail) / max(min(tail), 1e-9)
+
+
+def _run_converged(run_pass, max_passes: int = CONVERGE_MAX_PASSES) -> dict:
+    """Repeat ``run_pass()`` (returns events/sec) until the last
+    CONVERGE_TAIL rates agree within CONVERGE_TOL, then report the
+    median of that tail plus full per-pass attribution."""
+    rates, walls, loads = [], [], []
+    for _ in range(max_passes):
+        t0 = time.perf_counter()
+        rates.append(float(run_pass()))
+        walls.append(round(time.perf_counter() - t0, 3))
+        loads.append(round(os.getloadavg()[0], 2))
+        if (len(rates) >= CONVERGE_TAIL
+                and _tail_spread(rates) - 1.0 <= CONVERGE_TOL):
+            break
+    tail = sorted(rates[-CONVERGE_TAIL:])
+    return {
+        "events_per_sec": tail[len(tail) // 2],
+        "rates": [round(r, 1) for r in rates],
+        "tail_spread": round(_tail_spread(rates), 3),
+        "converged": _tail_spread(rates) - 1.0 <= CONVERGE_TOL,
+        "pass_walls_s": walls,
+        "pass_load1": loads,
+    }
+
+
+def _scanner_variant() -> str:
+    """Which JSON scanner the bridge will use in THIS process — the
+    single biggest structural determinant of the json-mode rate."""
+    from attendance_tpu.native import load as load_native
+
+    nat = load_native()
+    if nat is None:
+        return "python"
+    return "c-list" if getattr(nat, "has_list_scan", False) else "c-buffer"
 
 
 def _enable_compilation_cache() -> None:
@@ -91,33 +144,31 @@ def bench_fused_step(batch_size: int, seconds: float, capacity: int,
     state, valid = step(state, keys_bufs[0], bank_bufs[0], mask)
     valid.block_until_ready()
 
-    # Five measured windows, MEDIAN reported — same treatment as the
-    # e2e bench (VERDICT r03 weak #2: a single continuous window made a
-    # tunnel-weather swing indistinguishable from a code regression in
-    # the round artifact; the per-window spread classifies it).
-    rates = []
-    total_steps = 0
-    for _ in range(5):
-        steps, t0 = 0, time.perf_counter()
+    # Converge-then-measure windows (VERDICT r04 #1): loop state is
+    # threaded through the closure so each window continues the chain
+    # (the filter stays at its configured occupancy).
+    box = {"state": state, "steps": 0}
+
+    def one_window() -> float:
+        st, steps, t0 = box["state"], 0, time.perf_counter()
         while True:
-            state, valid = step(state, keys_bufs[steps % n_bufs],
-                                bank_bufs[steps % n_bufs], mask)
+            st, valid = step(st, keys_bufs[steps % n_bufs],
+                             bank_bufs[steps % n_bufs], mask)
             steps += 1
             if steps % 50 == 0:
                 valid.block_until_ready()
-                if time.perf_counter() - t0 >= seconds / 5:
+                if time.perf_counter() - t0 >= max(seconds / 5, 0.05):
                     break
         valid.block_until_ready()
-        rates.append(steps * batch_size / (time.perf_counter() - t0))
-        total_steps += steps
-    med = sorted(rates)[len(rates) // 2]
-    return {
-        "events_per_sec": med,
-        "rates": [round(r, 1) for r in sorted(rates)],
-        "steps": total_steps,
-        "batch_size": batch_size,
-        "device": str(jax.devices()[0]),
-    }
+        elapsed = time.perf_counter() - t0
+        box["state"] = st
+        box["steps"] += steps
+        return steps * batch_size / elapsed
+
+    r = _run_converged(one_window)
+    r.update(steps=box["steps"], batch_size=batch_size,
+             device=str(jax.devices()[0]))
+    return r
 
 
 def bench_bloom(batch_size: int, seconds: float, capacity: int,
@@ -231,8 +282,15 @@ def bench_hll(batch_size: int, seconds: float, num_banks: int) -> dict:
 
 
 def bench_e2e(batch_size: int, seconds: float, capacity: int,
-              num_banks: int) -> dict:
+              num_banks: int, snapshot_dir: str = "",
+              snapshot_every: int = 16,
+              max_passes: int = CONVERGE_MAX_PASSES) -> dict:
     """Broker -> fused processor -> columnar store, wall-clock end to end.
+
+    With ``snapshot_dir`` set, checkpointing runs AT RATE: the pipeline
+    snapshots every ``snapshot_every`` batches (ack barrier -> full
+    sketch D2H -> compressed write) and the per-snapshot stall is
+    recorded alongside the rate (VERDICT r04 #3).
 
     Unlike bench_fused_step this includes the real ingress: binary frame
     decode, bank mapping, padding, host->device transfer, ack-after-
@@ -248,9 +306,13 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
         MemoryBroker, MemoryClient)
 
     config = Config(bloom_filter_capacity=capacity,
-                    transport_backend="memory")
+                    transport_backend="memory",
+                    snapshot_dir=snapshot_dir or "",
+                    snapshot_every_batches=snapshot_every
+                    if snapshot_dir else 0)
     client = MemoryClient(MemoryBroker())
     pipe = FusedPipeline(config, client=client, num_banks=num_banks)
+
 
     # Size the backlog to cover `seconds` of steady-state processing,
     # rounded to whole frames so every frame shares one padded shape.
@@ -262,6 +324,13 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
     cap = max(8, int(2e9 / (batch_size * bytes_per_event)))
     num_frames = min(max(8, math.ceil(seconds * assumed_rate / batch_size)),
                      cap)
+    if snapshot_dir:
+        # The checkpointing variant needs enough frames for a couple
+        # of cadence barriers per pass — NOT seconds of healthy-rate
+        # backlog: each barrier hands a write to the background
+        # snapshotter, so an e2e-sized backlog would turn one pass
+        # into minutes of writer backpressure.
+        num_frames = min(num_frames, max(2 * snapshot_every, 16))
     num_events = num_frames * batch_size
     roster, frames = generate_frames(num_events, batch_size,
                                      roster_size=min(capacity, 1_000_000),
@@ -274,40 +343,49 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
     producer.send(frames[0])
     pipe.run(max_events=batch_size, idle_timeout_s=0.2)
 
-    # Five measured passes over the same backlog (frame bytes are
-    # re-sent by reference — no regeneration); the MEDIAN rate is
-    # reported. A single drain-bound pass on a shared host/tunnel sees
-    # multi-x run-to-run jitter; the median across five is the
-    # stablest artifact the per-round recording gets.
-    rates = []
-    for _ in range(5):
+    # Converged passes over the same backlog (frame bytes are re-sent
+    # by reference — no regeneration). Each pass is drain-bound; the
+    # reported rate is the median of the converged tail, with per-pass
+    # attribution recorded (VERDICT r04 #1: a blind median-of-5 landed
+    # mid-warmup and under-read dedicated reruns 2-6.5x).
+    def one_pass() -> float:
         for frame in frames:
             producer.send(frame)
         pipe.metrics.events = 0
         pipe.metrics.wall_seconds = 0.0
         pipe.run(max_events=num_events, idle_timeout_s=5.0)
-        if pipe.metrics.wall_seconds:
-            rates.append(pipe.metrics.events / pipe.metrics.wall_seconds)
         # Keep every pass identical: drop the append-only store's blocks
         # (each pass would otherwise retain ~num_events device-resident
         # validity lanes plus host column copies).
         pipe.store.truncate()
-    rates.sort()
-    median = rates[len(rates) // 2] if rates else 0.0
+        if not pipe.metrics.wall_seconds:
+            return 0.0
+        return pipe.metrics.events / pipe.metrics.wall_seconds
+
+    r = _run_converged(one_pass, max_passes=max_passes)
     # Which wire the adaptive ladder actually dispatched most frames on
     # (word/seg/delta/bytes) — the tunnel's momentary link-vs-host
     # balance decides, so the recorded artifact should say which regime
     # it measured.
     dwell = pipe.metrics.wire_dwell or {"word": 0}
-    return {
-        "events_per_sec": median,
-        "events": num_events,
-        "rates": [round(r, 1) for r in rates],
-        "batch_size": batch_size,
-        "wire": max(dwell, key=dwell.get),
-        "elapsed_s": pipe.metrics.wall_seconds,
-        "device": str(jax.devices()[0]),
-    }
+    r.update(events=num_events, batch_size=batch_size,
+             wire=max(dwell, key=dwell.get),
+             device=str(jax.devices()[0]))
+    if snapshot_dir:
+        # Per-snapshot write seconds + hot-loop backpressure waits come
+        # from the pipeline's own checkpointing metrics (the cadence
+        # barriers run on the async writer; VERDICT r04 #3).
+        stalls = sorted(pipe.metrics.snapshot_stalls)
+        r.update(
+            snapshots_taken=len(stalls),
+            snapshot_every_batches=snapshot_every,
+            snapshot_stall_s=round(stalls[len(stalls) // 2], 4)
+            if stalls else 0.0,
+            snapshot_stall_max_s=round(stalls[-1], 4) if stalls else 0.0,
+            snapshot_blocked_s=round(
+                pipe.metrics.snapshot_blocked_s, 4),
+        )
+    return r
 
 
 def bench_json(seconds: float, capacity: int, num_banks: int,
@@ -370,32 +448,271 @@ def bench_json(seconds: float, capacity: int, num_banks: int,
     bridge.run(max_events=bridge_batch, idle_timeout_s=0.2)
     pipe.run(max_events=bridge_batch, idle_timeout_s=0.2)
 
-    rates, bridge_rates, pipe_rates = [], [], []
-    for _ in range(5):
+    bridge_rates, pipe_rates = [], []
+
+    def one_pass() -> float:
         producer.send_many(payloads)
         bridge.metrics.events = 0
         pipe.metrics.events = 0
         bridge.run(max_events=num_events, idle_timeout_s=5.0)
         pipe.run(max_events=num_events, idle_timeout_s=5.0)
+        pipe.store.truncate()
         wall = bridge.metrics.wall_seconds + pipe.metrics.wall_seconds
-        if wall:
-            rates.append(num_events / wall)
         if bridge.metrics.wall_seconds:
             bridge_rates.append(num_events / bridge.metrics.wall_seconds)
         if pipe.metrics.wall_seconds:
             pipe_rates.append(num_events / pipe.metrics.wall_seconds)
-        pipe.store.truncate()
-    rates.sort()
-    median = rates[len(rates) // 2] if rates else 0.0
-    return {
-        "events_per_sec": median,
-        "events": num_events,
-        "rates": [round(r, 1) for r in rates],
-        "bridge_events_per_sec": round(float(np.median(bridge_rates)), 1)
+        return num_events / wall if wall else 0.0
+
+    r = _run_converged(one_pass)
+    tail = slice(-CONVERGE_TAIL, None)
+    r.update(
+        events=num_events,
+        bridge_events_per_sec=round(
+            float(np.median(bridge_rates[tail])), 1)
         if bridge_rates else 0.0,
-        "fused_events_per_sec": round(float(np.median(pipe_rates)), 1)
+        fused_events_per_sec=round(float(np.median(pipe_rates[tail])), 1)
         if pipe_rates else 0.0,
-        "device": str(jax.devices()[0]),
+        scanner=_scanner_variant(),
+        device=str(jax.devices()[0]),
+    )
+    return r
+
+
+def bench_socket(batch_size: int, seconds: float, capacity: int,
+                 num_banks: int) -> dict:
+    """The cross-process TCP lane (VERDICT r04 #4): binary frames and
+    the JSON bridge driven through a REAL BrokerServer subprocess over
+    localhost TCP — the horizontal scale-out front the reference gets
+    from Pulsar (reference attendance_processor.py:30-34) — reported
+    alongside nothing: callers compare against the memory-lane numbers
+    recorded in the same artifact.
+
+    Publisher re-sends cost real TCP time, so passes are shorter than
+    the memory-lane e2e; the chunk-lane receive amortizes round-trips
+    exactly as in-process."""
+    import subprocess
+    import sys
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.socket_broker import SocketClient
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "attendance_tpu.transport.socket_broker",
+         "--port", "0"],
+        stdout=subprocess.PIPE, text=True,
+        cwd=str(Path(__file__).resolve().parent))
+    addr = proc.stdout.readline().strip().rsplit(" ", 1)[-1]
+    try:
+        config = Config(bloom_filter_capacity=capacity,
+                        transport_backend="socket", socket_broker=addr)
+        client = SocketClient(addr)
+        pipe = FusedPipeline(config, client=client, num_banks=num_banks)
+        num_frames = max(4, min(32, math.ceil(seconds * 5e6 / batch_size)))
+        num_events = num_frames * batch_size
+        roster, frames = generate_frames(
+            num_events, batch_size, roster_size=min(capacity, 1_000_000),
+            num_lectures=num_banks)
+        frames = list(frames)
+        pipe.preload(roster)
+        producer = client.create_producer(config.pulsar_topic)
+
+        producer.send(frames[0])  # warmup: compile the padded shape
+        pipe.run(max_events=batch_size, idle_timeout_s=0.2)
+
+        def one_pass() -> float:
+            for frame in frames:
+                producer.send(frame)
+            pipe.metrics.events = 0
+            pipe.metrics.wall_seconds = 0.0
+            pipe.run(max_events=num_events, idle_timeout_s=5.0)
+            pipe.store.truncate()
+            if not pipe.metrics.wall_seconds:
+                return 0.0
+            return pipe.metrics.events / pipe.metrics.wall_seconds
+
+        r = _run_converged(one_pass, max_passes=6)
+        r.update(events=num_events, batch_size=batch_size,
+                 broker_address=addr, device=str(jax.devices()[0]))
+        client.close()
+        return r
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def _build_roster_filter(capacity: int):
+    """The ONE deterministic 10M-roster filter build shared by
+    bench_roster10m_tpu and its acceptance subprocess: the acceptance
+    scalars are only valid while both processes construct byte-
+    identical filters, so the construction must not be duplicated.
+    Returns (bits, params, roster_lo, roster_hi, preload_seconds)."""
+    from attendance_tpu.models.bloom import bloom_add_packed
+    from attendance_tpu.models.fused import init_state
+    from attendance_tpu.pipeline.fast_path import chunked_preload
+
+    state, params = init_state(capacity=capacity, error_rate=0.01,
+                               layout="blocked", num_banks=64)
+    # Dense roster (hashing makes id density irrelevant to the filter);
+    # the disjoint high range is the negative population.
+    roster_lo, roster_hi = 1 << 20, (1 << 20) + capacity
+    preload = jax.jit(lambda b, k: bloom_add_packed(b, k, params),
+                      donate_argnums=(0,))
+    bits = state.bloom_bits
+    tp = time.perf_counter()
+    chunk = 1 << 20
+    for start in range(roster_lo, roster_hi, chunk):
+        bits = chunked_preload(
+            preload, bits,
+            np.arange(start, min(start + chunk, roster_hi),
+                      dtype=np.uint32))
+    bits.block_until_ready()
+    return (bits, params, roster_lo, roster_hi,
+            time.perf_counter() - tp)
+
+
+def bench_roster10m_tpu(batch_size: int, seconds: float,
+                        capacity: int = 10_000_000) -> dict:
+    """BASELINE.md config #4 ON THE DEFAULT DEVICE (VERDICT r04 #2: the
+    real chip had never executed a 10M-capacity filter — every hardware
+    number used <= 1M and the 10M evidence lived on the CPU mesh).
+
+    Order matters on this platform: the chunked 10M-key preload and the
+    converged fused-step rate at the ~12MB table size run FIRST; the
+    acceptance scalars (zero false negatives on a 100k member sample,
+    FPR on a disjoint 100k sample, device-side fill fraction) are
+    device-reduced and read back only AFTER the last timed window, so
+    the documented D2H dispatch-collapse pathology cannot poison the
+    recorded rate."""
+    from attendance_tpu.models.fused import init_state, make_jitted_step
+
+    num_banks = 64
+    t_all = time.perf_counter()
+    bits, params, roster_lo, roster_hi, preload_s = \
+        _build_roster_filter(capacity)
+    state, _ = init_state(capacity=capacity, error_rate=0.01,
+                          layout="blocked", num_banks=num_banks)
+    step = make_jitted_step(params)
+    rng = np.random.default_rng(23)
+    # The timed chain gets a device-side COPY of the filter: the jitted
+    # step donates its whole state every call, so after ~10^5 chained
+    # steps any read of a chain-descended buffer resolves the entire
+    # donation journal through the relay (minutes — the documented
+    # platform pathology). The original `bits` stays a one-hop array
+    # the acceptance reads below can fetch in milliseconds.
+    state = state._replace(bloom_bits=jnp.bitwise_or(bits, np.uint32(0)))
+
+    n_bufs = 8
+    keys_bufs = [jax.device_put(np.where(
+        rng.random(batch_size) < 0.5,
+        rng.integers(roster_lo, roster_hi, batch_size),
+        rng.integers(1 << 28, 1 << 29, batch_size)
+    ).astype(np.uint32)) for _ in range(n_bufs)]
+    bank_bufs = [jax.device_put(
+        rng.integers(0, num_banks, size=batch_size, dtype=np.int32))
+        for _ in range(n_bufs)]
+    mask = jax.device_put(np.ones(batch_size, dtype=bool))
+    state, valid = step(state, keys_bufs[0], bank_bufs[0], mask)
+    valid.block_until_ready()
+
+    box = {"state": state}
+
+    # Same window methodology as the kernel bench (async dispatch,
+    # block every 50 steps, converge-then-measure). Nothing in THIS
+    # process ever host-reads after the chain: every chained donated
+    # step adds ~0.2-0.4s to the first later read at this state size
+    # (the relay resolves its deferred-dispatch journal at read time —
+    # measured 200 steps -> ~80s; the r04 pathology at 10x the state).
+    def one_window() -> float:
+        st, steps, t0 = box["state"], 0, time.perf_counter()
+        while True:
+            st, valid = step(st, keys_bufs[steps % n_bufs],
+                             bank_bufs[steps % n_bufs], mask)
+            steps += 1
+            if steps % 50 == 0:
+                valid.block_until_ready()
+                if time.perf_counter() - t0 >= max(seconds / 5, 0.05):
+                    break
+        valid.block_until_ready()
+        box["state"] = st
+        return steps * batch_size / (time.perf_counter() - t0)
+
+    r = _run_converged(one_window)
+
+    # Acceptance scalars in a FRESH SUBPROCESS: the deterministic
+    # arange preload rebuilds the identical filter with a ~30-step
+    # journal, so its reads cost seconds — paying this process's
+    # multi-thousand-step journal would cost many minutes, and doing
+    # the reads before the windows would leave the windows measuring
+    # the post-D2H collapsed dispatch mode instead of the device
+    # program.
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    if jax.default_backend() == "cpu":
+        # Hermetic (test) runs stay hermetic: the child must not fall
+        # through to the real device the parent was forced off of.
+        env["ATP_BENCH_PLATFORM"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, str(Path(__file__).resolve()),
+         "--mode", "roster10m-accept", "--capacity", str(capacity)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(Path(__file__).resolve().parent))
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError(
+            f"roster10m-accept subprocess failed (rc={out.returncode}):"
+            f"\n{out.stderr[-4000:]}")
+    accept = json.loads(out.stdout.strip().splitlines()[-1])
+    fn = accept["false_negatives_of_100k"]
+    fpr = accept["fpr_of_100k_disjoint"]
+    fill = accept["fill_fraction"]
+    r.update(
+        capacity=capacity,
+        preload_seconds=round(preload_s, 1),
+        preload_keys_per_sec=round(capacity / preload_s, 1),
+        filter_bytes=params.m_bits // 8,
+        batch_size=batch_size,
+        false_negatives_of_100k=fn,
+        fpr_of_100k_disjoint=fpr,
+        fill_fraction=fill,
+        accept_read_seconds=accept["accept_read_seconds"],
+        wall_seconds=round(time.perf_counter() - t_all, 1),
+        device=str(jax.devices()[0]),
+    )
+    return r
+
+
+def bench_roster10m_accept(capacity: int) -> dict:
+    """Acceptance half of --mode=roster10m-tpu, run in its own process
+    (see that mode's docstring): rebuild the identical filter via the
+    shared deterministic build, then read three device-reduced scalars
+    while the process journal is only ~preload-deep."""
+    from attendance_tpu.models.bloom import (
+        bloom_contains_words, bloom_packed_fill_fraction)
+
+    bits, params, roster_lo, roster_hi, _ = \
+        _build_roster_filter(capacity)
+    rng = np.random.default_rng(23)
+    members = jax.device_put(
+        rng.integers(roster_lo, roster_hi, 100_000).astype(np.uint32))
+    outsiders = jax.device_put(
+        rng.integers(1 << 28, 1 << 29, 100_000).astype(np.uint32))
+    accept = jax.jit(lambda b, m, o: (
+        jnp.sum(~bloom_contains_words(b, m, params)),
+        jnp.mean(bloom_contains_words(b, o, params
+                                      ).astype(jnp.float32)),
+        bloom_packed_fill_fraction(b)))
+    t0 = time.perf_counter()
+    fn_d, fpr_d, fill_d = accept(bits, members, outsiders)
+    return {
+        "false_negatives_of_100k": int(fn_d),
+        "fpr_of_100k_disjoint": round(float(fpr_d), 5),
+        "fill_fraction": round(float(fill_d), 5),
+        "accept_read_seconds": round(time.perf_counter() - t0, 1),
+        "capacity": capacity,
     }
 
 
@@ -465,6 +782,15 @@ def bench_sharded_step(batch_size: int, seconds: float, capacity: int,
     return {
         "events_per_sec": steps * batch_size / elapsed,
         "steps": steps, "batch_size": batch_size,
+        # Honest marker (VERDICT r04 weak #3): with one device the mesh
+        # is (dp=1, sp=1) and the engine's degenerate-mesh build runs
+        # the single-chip kernel suite (value-identical by construction,
+        # pinned by cross-shape tests) — this number is NOT multi-device
+        # hardware evidence, and the SPMD-partitioned executable class
+        # remains unusable on this relay-tunneled platform (PARITY.md
+        # "Sharded step on the tunneled chip").
+        "degenerate_mesh": True,
+        "partitioned_executables": "unusable-on-platform",
         "device": str(jax.devices()[0]),
     }
 
@@ -617,13 +943,20 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="both",
                     choices=["both", "kernel", "e2e", "json", "wires",
-                             "sharded", "bloom", "hll", "roster10m"],
+                             "sharded", "bloom", "hll", "roster10m",
+                             "roster10m-tpu", "roster10m-accept",
+                             "snapshot", "socket"],
                     help="both/kernel/e2e are the headline benches; "
                     "json times the reference-wire JSON ingress "
                     "(bridge -> fused pipe); wires compares the forced "
                     "wire formats interleaved + the raw link rate; "
                     "bloom and hll time the standalone sketch kernels "
-                    "(BASELINE.md configs #2 and #3)")
+                    "(BASELINE.md configs #2 and #3); roster10m-tpu "
+                    "runs the 10M-capacity filter on the default "
+                    "device; snapshot measures the e2e rate with "
+                    "checkpointing ON plus the per-snapshot stall; "
+                    "socket drives binary frames through a real "
+                    "BrokerServer subprocess over TCP")
     ap.add_argument("--batch-size", type=int, default=1 << 20,
                     help="kernel-mode device batch size")
     ap.add_argument("--e2e-batch-size", type=int, default=None,
@@ -636,6 +969,12 @@ def main() -> None:
                     "matching BASELINE.md config #3)")
     ap.add_argument("--layout", default="blocked",
                     choices=["blocked", "flat"])
+    ap.add_argument("--snapshot-every-batches", type=int, default=32,
+                    help="snapshot cadence for --mode=snapshot and the "
+                    "snapshot section of --mode=both (32 batches of "
+                    "2^19 events ~ one snapshot per ~0.4s of healthy "
+                    "stream — a cadence the background writer can "
+                    "sustain without backpressure)")
     ap.add_argument("--profile-dir", default="",
                     help="write a jax.profiler trace of the bench here")
     args = ap.parse_args()
@@ -644,16 +983,22 @@ def main() -> None:
     # e2e frame size comes from --e2e-batch-size.
     if args.e2e_batch_size is None:
         args.e2e_batch_size = (args.batch_size if args.mode == "e2e"
+                               else 1 << 17
+                               if args.mode in ("snapshot", "socket")
                                else 1 << 19)
     if args.num_banks is None:
         args.num_banks = 1024 if args.mode == "hll" else 64
+    if os.environ.get("ATP_BENCH_PLATFORM"):
+        # Helper subprocesses (roster10m-accept, the snapshot section
+        # of --mode=both) inherit the parent's forced platform so
+        # hermetic runs stay hermetic.
+        jax.config.update("jax_platforms",
+                          os.environ["ATP_BENCH_PLATFORM"])
     if args.mode == "roster10m":
         # Force the 8-virtual-device CPU mesh BEFORE the backend
         # initializes: config #4's acceptance checks are mesh-shape and
         # scale properties, and the 100k-probe D2H reads in it would
         # poison a tunneled-TPU process anyway (fast_path.run notes).
-        import os
-
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=8")
@@ -723,6 +1068,50 @@ def main() -> None:
                 "per_wire_events_per_sec": r["per_wire_events_per_sec"],
                 "link_bytes_per_sec": r["link_bytes_per_sec"],
             }
+        elif args.mode == "snapshot":
+            import tempfile
+
+            with tempfile.TemporaryDirectory() as snap_dir:
+                r = bench_e2e(args.e2e_batch_size, args.seconds,
+                              args.capacity, args.num_banks,
+                              snapshot_dir=snap_dir,
+                              snapshot_every=args.snapshot_every_batches,
+                              max_passes=4)
+            line = {
+                "metric": "e2e_snapshot_throughput",
+                "value": round(r["events_per_sec"], 1),
+                "unit": "events/sec",
+                "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+                **{k: r[k] for k in
+                   ("rates", "converged", "tail_spread", "pass_load1",
+                    "snapshots_taken", "snapshot_every_batches",
+                    "snapshot_stall_s", "snapshot_stall_max_s",
+                    "snapshot_blocked_s", "wire", "device")},
+            }
+        elif args.mode == "socket":
+            r = bench_socket(args.e2e_batch_size, args.seconds,
+                             args.capacity, args.num_banks)
+            line = {
+                "metric": "socket_events_per_sec",
+                "value": round(r["events_per_sec"], 1),
+                "unit": "events/sec",
+                "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+                **{k: r[k] for k in
+                   ("rates", "converged", "tail_spread", "pass_load1",
+                    "events", "batch_size", "device")},
+            }
+        elif args.mode == "roster10m-accept":
+            # Helper half of roster10m-tpu (own process: short journal).
+            line = bench_roster10m_accept(args.capacity)
+        elif args.mode == "roster10m-tpu":
+            r = bench_roster10m_tpu(args.batch_size, args.seconds)
+            line = {
+                "metric": "roster10m_tpu_step_events_per_sec",
+                "value": round(r["events_per_sec"], 1),
+                "unit": "events/sec",
+                "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+                **{k: v for k, v in r.items() if k != "events_per_sec"},
+            }
         elif args.mode == "roster10m":
             r = bench_roster10m()
             line = {
@@ -746,23 +1135,74 @@ def main() -> None:
                 "fused_events_per_sec": r["fused_events_per_sec"],
             }
         else:  # both: headline the honest e2e number + kernel alongside
-            # Raw link probe FIRST: the host->device transfer rate is
-            # the dominant environmental variable (swings multi-x with
-            # tunnel weather); recording it makes every number below
-            # self-attributing — a kernel/e2e swing between rounds is
-            # classifiable as weather vs regression from the artifact
-            # alone (VERDICT r03 weak #2).
-            link = _probe_link_rate()
-            e2e = bench_e2e(args.e2e_batch_size, args.seconds,
-                            args.capacity, args.num_banks)
-            kern = bench_fused_step(args.batch_size, args.seconds,
-                                    args.capacity, args.num_banks,
-                                    args.layout)
+            # A raw link probe runs before EVERY section (VERDICT r04
+            # #1: one up-front probe could not attribute a mid-run
+            # weather swing): the host->device transfer rate is the
+            # dominant environmental variable, swinging multi-x with
+            # tunnel weather, and the per-section probes plus per-pass
+            # loadavg/wall-times make each section self-attributing.
+            import sys as _sys
+
+            section_walls = {}
+
+            def _timed(name, fn, *a, **kw):
+                t0 = time.perf_counter()
+                out = fn(*a, **kw)
+                section_walls[name] = round(time.perf_counter() - t0, 1)
+                print(f"[bench] {name}: {section_walls[name]}s",
+                      file=_sys.stderr, flush=True)
+                return out
+
+            links = {"e2e": _probe_link_rate()}
+            e2e = _timed("e2e", bench_e2e, args.e2e_batch_size,
+                         args.seconds, args.capacity, args.num_banks)
+            links["kernel"] = _probe_link_rate()
+            kern = _timed("kernel", bench_fused_step, args.batch_size,
+                          args.seconds, args.capacity, args.num_banks,
+                          args.layout)
             # The reference's actual wire is per-event JSON — record its
             # ingress rate in every round's artifact (VERDICT r02 #4),
             # at a shorter window (it is host-bound and steadier).
-            jsn = bench_json(min(args.seconds, 3.0), args.capacity,
-                             args.num_banks)
+            links["json"] = _probe_link_rate()
+            jsn = _timed("json", bench_json, min(args.seconds, 3.0),
+                         args.capacity, args.num_banks)
+            # TCP front (VERDICT r04 #4), short window.
+            sock = _timed("socket", bench_socket, 1 << 17,
+                          min(args.seconds, 3.0), args.capacity,
+                          args.num_banks)
+            # Checkpointing at rate (VERDICT r04 #3) runs in its own
+            # SUBPROCESS: its snapshot barriers do real D2H reads, and
+            # by this point the parent has dispatched ~10^5 donated
+            # steps — the first read in THIS process would resolve the
+            # relay's whole deferred-dispatch journal (hours), and a
+            # read before the other sections would leave them measuring
+            # the post-D2H collapsed dispatch mode.
+            import subprocess
+            import sys
+
+            links["snapshot"] = _probe_link_rate()
+
+            def _snapshot_sub() -> dict:
+                env = dict(os.environ)
+                if jax.default_backend() == "cpu":
+                    env["ATP_BENCH_PLATFORM"] = "cpu"
+                out = subprocess.run(
+                    [sys.executable, str(Path(__file__).resolve()),
+                     "--mode", "snapshot",
+                     "--seconds", str(min(args.seconds, 2.0)),
+                     "--capacity", str(args.capacity),
+                     "--num-banks", str(args.num_banks),
+                     "--snapshot-every-batches",
+                     str(args.snapshot_every_batches)],
+                    capture_output=True, text=True, timeout=560,
+                    env=env, cwd=str(Path(__file__).resolve().parent))
+                if out.returncode != 0 or not out.stdout.strip():
+                    raise RuntimeError(
+                        f"snapshot subprocess failed "
+                        f"(rc={out.returncode}):\n{out.stderr[-4000:]}")
+                return json.loads(out.stdout.strip().splitlines()[-1])
+
+            snap = _timed("snapshot", _snapshot_sub)
             line = {
                 "metric": "e2e_pipeline_throughput",
                 "value": round(e2e["events_per_sec"], 1),
@@ -770,15 +1210,37 @@ def main() -> None:
                 "vs_baseline": round(
                     _vs_baseline(e2e["events_per_sec"]), 4),
                 "wire": e2e["wire"],
-                "link_bytes_per_sec": round(link, 1),
+                "link_bytes_per_sec": {
+                    k: round(v, 1) for k, v in links.items()},
                 "e2e_rates": e2e["rates"],
+                "e2e_converged": e2e["converged"],
+                "e2e_tail_spread": e2e["tail_spread"],
+                "e2e_pass_load1": e2e["pass_load1"],
+                "e2e_pass_walls_s": e2e["pass_walls_s"],
                 "kernel_events_per_sec": round(kern["events_per_sec"], 1),
                 "kernel_vs_baseline": round(
                     _vs_baseline(kern["events_per_sec"]), 4),
                 "kernel_rates": kern["rates"],
+                "kernel_converged": kern["converged"],
+                "kernel_tail_spread": kern["tail_spread"],
                 "json_ingress_events_per_sec": round(
                     jsn["events_per_sec"], 1),
                 "json_rates": jsn["rates"],
+                "json_converged": jsn["converged"],
+                "json_scanner": jsn["scanner"],
+                "json_bridge_events_per_sec":
+                    jsn["bridge_events_per_sec"],
+                "socket_events_per_sec": round(
+                    sock["events_per_sec"], 1),
+                "socket_rates": sock["rates"],
+                "e2e_snapshot_events_per_sec": round(
+                    snap["value"], 1),
+                "snapshot_rates": snap["rates"],
+                "snapshot_stall_s": snap["snapshot_stall_s"],
+                "snapshot_stall_max_s": snap["snapshot_stall_max_s"],
+                "snapshot_blocked_s": snap["snapshot_blocked_s"],
+                "snapshots_taken": snap["snapshots_taken"],
+                "snapshot_every_batches": snap["snapshot_every_batches"],
             }
     print(json.dumps(line))
 

@@ -45,7 +45,50 @@ def test_e2e_smoke():
     assert r["events_per_sec"] > 0
     assert r["events"] >= 2048
     assert r["wire"] in ("word", "seg", "delta", "bytes", "arrays")
-    assert len(r["rates"]) == 5
+    # Converge-then-measure: between CONVERGE_TAIL and the cap, with
+    # per-pass attribution recorded alongside.
+    assert bench.CONVERGE_TAIL <= len(r["rates"]) <= \
+        bench.CONVERGE_MAX_PASSES
+    assert len(r["pass_walls_s"]) == len(r["rates"])
+    assert len(r["pass_load1"]) == len(r["rates"])
+    assert isinstance(r["converged"], bool)
+    assert r["tail_spread"] >= 1.0
+
+
+def test_e2e_snapshot_smoke(tmp_path):
+    """Checkpointing at rate: snapshots actually fire during the
+    measured passes and their stalls are recorded."""
+    r = bench.bench_e2e(batch_size=1024, seconds=0.2, capacity=10_000,
+                        num_banks=8, snapshot_dir=str(tmp_path),
+                        snapshot_every=2, max_passes=3)
+    assert r["events_per_sec"] > 0
+    assert r["snapshots_taken"] >= 1
+    assert r["snapshot_stall_s"] > 0
+    assert r["snapshot_stall_max_s"] >= r["snapshot_stall_s"]
+    from attendance_tpu.pipeline.fast_path import (
+        EVENTS_SEGMENTS, SKETCH_SNAPSHOT)
+    assert (tmp_path / SKETCH_SNAPSHOT).exists()
+    assert list((tmp_path / EVENTS_SEGMENTS).glob("segment-*.npz"))
+
+
+def test_socket_smoke():
+    r = bench.bench_socket(batch_size=1024, seconds=0.2,
+                           capacity=10_000, num_banks=8)
+    assert r["events_per_sec"] > 0
+    assert r["events"] >= 1024
+    assert ":" in r["broker_address"]
+
+
+def test_roster10m_tpu_smoke():
+    """The real-chip 10M mode at toy capacity: structure + acceptance
+    fields (the 10M run itself is a driver/round artifact)."""
+    r = bench.bench_roster10m_tpu(batch_size=1024, seconds=0.2,
+                                  capacity=50_000)
+    assert r["events_per_sec"] > 0
+    assert r["false_negatives_of_100k"] == 0
+    assert r["fpr_of_100k_disjoint"] <= 0.02
+    assert 0 < r["fill_fraction"] < 1
+    assert r["preload_keys_per_sec"] > 0
 
 
 def test_json_smoke():
@@ -55,12 +98,17 @@ def test_json_smoke():
     assert r["bridge_events_per_sec"] > 0
     assert r["fused_events_per_sec"] > 0
     assert r["events"] % 1024 == 0
+    assert r["scanner"] in ("python", "c-list", "c-buffer")
 
 
 def test_sharded_step_smoke():
     r = bench.bench_sharded_step(batch_size=1024, seconds=0.2,
                                  capacity=10_000, num_banks=8)
     assert r["events_per_sec"] > 0
+    # Honest-artifact marker (VERDICT r04 weak #3): the artifact itself
+    # must say the number measures the degenerate-mesh build.
+    assert r["degenerate_mesh"] is True
+    assert "unusable" in r["partitioned_executables"]
 
 
 def test_wires_smoke():
@@ -89,6 +137,14 @@ def test_main_emits_one_json_line(capsys, monkeypatch):
     assert "vs_baseline" in line
     assert "kernel_events_per_sec" in line
     assert "json_ingress_events_per_sec" in line
+    # r05 self-attribution fields: per-section link probes, converged
+    # flags, the socket lane, and checkpointing-at-rate.
+    assert set(line["link_bytes_per_sec"]) == \
+        {"e2e", "kernel", "json", "snapshot"}
+    assert isinstance(line["e2e_converged"], bool)
+    assert line["socket_events_per_sec"] > 0
+    assert line["e2e_snapshot_events_per_sec"] > 0
+    assert line["snapshots_taken"] >= 1
 
 
 def test_vs_baseline_share():
