@@ -151,9 +151,48 @@ def run_pipeline_workload(mesh) -> dict:
     }
 
 
+def run_crash_workload(mesh, snap_dir: str) -> dict:
+    """Phase A of the DCN crash/restore test (VERDICT r04 #5): the
+    FusedPipeline on the 2-process mesh processes the FIRST HALF of a
+    deterministic frame stream with checkpointing on (snapshot barriers
+    mid-run; only process 0 writes the shared snapshot_dir), then
+    returns — the parent SIGKILLs both processes, so whatever the
+    snapshot captured is all that survives. The parent later restores
+    onto a fresh single-process mesh and replays the unacked second
+    half (what Pulsar redelivery would do) against a no-crash oracle."""
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(bloom_filter_capacity=20_000,
+                    transport_backend="memory",
+                    num_shards=mesh.shape["sp"],
+                    num_replicas=mesh.shape["dp"],
+                    wire_format="word",
+                    snapshot_dir=snap_dir, snapshot_every_batches=2)
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8, mesh=mesh)
+    num_events, batch = 16_384, 2_048
+    roster, frames = generate_frames(num_events, batch,
+                                     roster_size=8_000, num_lectures=8,
+                                     invalid_fraction=0.2, seed=93)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    # First half only: the snapshot cadence (every 2 batches) barriers
+    # mid-run; the second half stays unacked for the restore to replay.
+    pipe.run(max_events=num_events // 2, idle_timeout_s=1.0)
+    return {"crash_events": pipe.metrics.events,
+            "crash_validity_counts": list(pipe.validity_counts())}
+
+
 def main() -> None:
     proc_id, num_procs = int(sys.argv[1]), int(sys.argv[2])
     port, out_path = sys.argv[3], sys.argv[4]
+    crash_snap_dir = sys.argv[5] if len(sys.argv) > 5 else None
 
     import os
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -181,6 +220,19 @@ def main() -> None:
         raise AssertionError("straddling mesh must be rejected")
     except ValueError:
         pass
+
+    if crash_snap_dir is not None:
+        result = run_crash_workload(mesh, crash_snap_dir)
+        result["process_id"] = proc_id
+        result["process_count"] = jax.process_count()
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+        print(f"[p{proc_id}] SNAPPED", flush=True)
+        # Hold the process (and its un-acked broker state) until the
+        # parent SIGKILLs it — a real crash, no teardown runs.
+        import time
+        time.sleep(600)
+        return
 
     result = run_workload(mesh)
     result.update(run_pipeline_workload(mesh))
