@@ -1053,6 +1053,11 @@ def main() -> None:
                 "value": round(r["events_per_sec"], 1),
                 "unit": "events/sec",
                 "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+                # VERDICT r04 weak #3: the artifact itself must say the
+                # number measures the degenerate-mesh build.
+                "degenerate_mesh": r["degenerate_mesh"],
+                "partitioned_executables": r["partitioned_executables"],
+                "device": r["device"],
             }
         elif args.mode == "wires":
             r = bench_wires(args.seconds, args.capacity, args.num_banks)
@@ -1167,6 +1172,7 @@ def main() -> None:
             jsn = _timed("json", bench_json, min(args.seconds, 3.0),
                          args.capacity, args.num_banks)
             # TCP front (VERDICT r04 #4), short window.
+            links["socket"] = _probe_link_rate()
             sock = _timed("socket", bench_socket, 1 << 17,
                           min(args.seconds, 3.0), args.capacity,
                           args.num_banks)

@@ -140,7 +140,7 @@ def test_main_emits_one_json_line(capsys, monkeypatch):
     # r05 self-attribution fields: per-section link probes, converged
     # flags, the socket lane, and checkpointing-at-rate.
     assert set(line["link_bytes_per_sec"]) == \
-        {"e2e", "kernel", "json", "snapshot"}
+        {"e2e", "kernel", "json", "socket", "snapshot"}
     assert isinstance(line["e2e_converged"], bool)
     assert line["socket_events_per_sec"] > 0
     assert line["e2e_snapshot_events_per_sec"] > 0
