@@ -84,6 +84,24 @@ def _run_converged(run_pass, max_passes: int = CONVERGE_MAX_PASSES) -> dict:
     }
 
 
+def _window_rate(step_once, events_per_step: int,
+                 window_s: float) -> float:
+    """One converge-pass window over a device-dispatch loop: call
+    ``step_once(i)`` repeatedly (it returns a device value), blocking
+    every 50 dispatches, until ``window_s`` elapses; returns events/sec.
+    The single policy point for every kernel-style bench window."""
+    steps, t0 = 0, time.perf_counter()
+    while True:
+        out = step_once(steps)
+        steps += 1
+        if steps % 50 == 0:
+            out.block_until_ready()
+            if time.perf_counter() - t0 >= window_s:
+                break
+    out.block_until_ready()
+    return steps * events_per_step / (time.perf_counter() - t0)
+
+
 def _scanner_variant() -> str:
     """Which JSON scanner the bridge will use in THIS process — the
     single biggest structural determinant of the json-mode rate."""
@@ -149,23 +167,14 @@ def bench_fused_step(batch_size: int, seconds: float, capacity: int,
     # (the filter stays at its configured occupancy).
     box = {"state": state, "steps": 0}
 
-    def one_window() -> float:
-        st, steps, t0 = box["state"], 0, time.perf_counter()
-        while True:
-            st, valid = step(st, keys_bufs[steps % n_bufs],
-                             bank_bufs[steps % n_bufs], mask)
-            steps += 1
-            if steps % 50 == 0:
-                valid.block_until_ready()
-                if time.perf_counter() - t0 >= max(seconds / 5, 0.05):
-                    break
-        valid.block_until_ready()
-        elapsed = time.perf_counter() - t0
-        box["state"] = st
-        box["steps"] += steps
-        return steps * batch_size / elapsed
+    def step_once(i: int):
+        box["state"], valid = step(box["state"], keys_bufs[i % n_bufs],
+                                   bank_bufs[i % n_bufs], mask)
+        box["steps"] += 1
+        return valid
 
-    r = _run_converged(one_window)
+    r = _run_converged(lambda: _window_rate(
+        step_once, batch_size, max(seconds / 5, 0.05)))
     r.update(steps=box["steps"], batch_size=batch_size,
              device=str(jax.devices()[0]))
     return r
@@ -194,46 +203,39 @@ def bench_bloom(batch_size: int, seconds: float, capacity: int,
     # Membership query rate FIRST, against the filter at its configured
     # occupancy — timing it after the insert chain would query a
     # saturated filter and make the 50/50 positive/negative mix above
-    # meaningless.
+    # meaningless. Converge-then-measure windows like the headline
+    # modes (r05 artifact policy).
     out = query(bits, bufs[0])
     out.block_until_ready()
-    steps, t0 = 0, time.perf_counter()
-    while True:
-        out = query(bits, bufs[steps % 8])
-        steps += 1
-        if steps % 50 == 0:
-            out.block_until_ready()
-            if time.perf_counter() - t0 >= seconds / 2:
-                break
-    out.block_until_ready()
-    elapsed = time.perf_counter() - t0
-    query_rate = steps * batch_size / elapsed
+    qr = _run_converged(lambda: _window_rate(
+        lambda i: query(bits, bufs[i % 8]), batch_size,
+        max(seconds / 10, 0.05)))
 
-    # Insert (scatter-OR) rate: donated chain, half the window. Reuses
-    # the preload program's chunk shape — the 2^20-key scatter variant
-    # hits a pathological XLA compile on this backend, and one compiled
+    # Insert (scatter-OR) rate: donated chain. Reuses the preload
+    # program's chunk shape — the 2^20-key scatter variant hits a
+    # pathological XLA compile on this backend, and one compiled
     # scatter shape is the library's own chunked-preload policy anyway.
     from attendance_tpu.models.bloom import PRELOAD_CHUNK
 
     ibufs = [jax.device_put(
         rng.integers(0, 1 << 31, size=PRELOAD_CHUNK, dtype=np.uint32))
         for _ in range(8)]
-    bits = add(bits, ibufs[0])
-    bits.block_until_ready()
-    isteps, t0 = 0, time.perf_counter()
-    while True:
-        bits = add(bits, ibufs[isteps % 8])
-        isteps += 1
-        if isteps % 50 == 0:
-            bits.block_until_ready()
-            if time.perf_counter() - t0 >= seconds / 2:
-                break
-    bits.block_until_ready()
-    insert_rate = isteps * PRELOAD_CHUNK / (time.perf_counter() - t0)
+    box = {"bits": add(bits, ibufs[0])}
+    box["bits"].block_until_ready()
 
-    return {"events_per_sec": query_rate,
-            "insert_keys_per_sec": insert_rate,
-            "steps": steps, "batch_size": batch_size}
+    def insert_once(i: int):
+        box["bits"] = add(box["bits"], ibufs[i % 8])
+        return box["bits"]
+
+    ir = _run_converged(lambda: _window_rate(
+        insert_once, PRELOAD_CHUNK, max(seconds / 10, 0.05)))
+    r = dict(qr)
+    r.update(insert_keys_per_sec=ir["events_per_sec"],
+             insert_rates=ir["rates"],
+             insert_converged=ir["converged"],
+             insert_tail_spread=ir["tail_spread"],
+             batch_size=batch_size)
+    return r
 
 
 def bench_hll(batch_size: int, seconds: float, num_banks: int) -> dict:
@@ -264,21 +266,22 @@ def bench_hll(batch_size: int, seconds: float, num_banks: int) -> dict:
     # documents), which would bench the wreckage instead of the kernel.
     # The PFCOUNT histograms therefore stay device-resident; accuracy
     # is pinned by tests/test_hll.py and the redis parity harness.
-    steps, t0 = 0, time.perf_counter()
-    while True:
-        regs = add(regs, bank_bufs[steps % 8], key_bufs[steps % 8])
-        steps += 1
-        if steps % 256 == 0:
-            h = hist(regs)
-        if steps % 50 == 0:
-            regs.block_until_ready()
-            if time.perf_counter() - t0 >= seconds:
-                break
-    jax.block_until_ready((regs, h))
-    elapsed = time.perf_counter() - t0
-    return {"events_per_sec": steps * batch_size / elapsed,
-            "steps": steps, "batch_size": batch_size,
-            "num_banks": num_banks}
+    box = {"regs": regs, "h": h, "steps": 0}
+
+    def step_once(i: int):
+        box["regs"] = add(box["regs"], bank_bufs[i % 8],
+                          key_bufs[i % 8])
+        box["steps"] += 1
+        if box["steps"] % 256 == 0:
+            box["h"] = hist(box["regs"])
+        return box["regs"]
+
+    r = _run_converged(lambda: _window_rate(
+        step_once, batch_size, max(seconds / 5, 0.05)))
+    jax.block_until_ready(box["h"])
+    r.update(steps=box["steps"], batch_size=batch_size,
+             num_banks=num_banks)
+    return r
 
 
 def bench_e2e(batch_size: int, seconds: float, capacity: int,
@@ -358,6 +361,14 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
         # (each pass would otherwise retain ~num_events device-resident
         # validity lanes plus host column copies).
         pipe.store.truncate()
+        if pipe.metrics.dead_lettered:
+            # Fail loudly on the FIRST broken pass: the quiet
+            # alternative is a 0.0 artifact that reads as a perf
+            # crater instead of a broken pipeline.
+            raise RuntimeError(
+                f"e2e bench dead-lettered "
+                f"{pipe.metrics.dead_lettered} frames — the pipeline "
+                "is broken, not slow")
         if not pipe.metrics.wall_seconds:
             return 0.0
         return pipe.metrics.events / pipe.metrics.wall_seconds
@@ -529,6 +540,11 @@ def bench_socket(batch_size: int, seconds: float, capacity: int,
             pipe.metrics.wall_seconds = 0.0
             pipe.run(max_events=num_events, idle_timeout_s=5.0)
             pipe.store.truncate()
+            if pipe.metrics.dead_lettered:
+                raise RuntimeError(
+                    f"socket bench dead-lettered "
+                    f"{pipe.metrics.dead_lettered} frames — the "
+                    "pipeline is broken, not slow")
             if not pipe.metrics.wall_seconds:
                 return 0.0
             return pipe.metrics.events / pipe.metrics.wall_seconds
@@ -625,21 +641,13 @@ def bench_roster10m_tpu(batch_size: int, seconds: float,
     # step adds ~0.2-0.4s to the first later read at this state size
     # (the relay resolves its deferred-dispatch journal at read time —
     # measured 200 steps -> ~80s; the r04 pathology at 10x the state).
-    def one_window() -> float:
-        st, steps, t0 = box["state"], 0, time.perf_counter()
-        while True:
-            st, valid = step(st, keys_bufs[steps % n_bufs],
-                             bank_bufs[steps % n_bufs], mask)
-            steps += 1
-            if steps % 50 == 0:
-                valid.block_until_ready()
-                if time.perf_counter() - t0 >= max(seconds / 5, 0.05):
-                    break
-        valid.block_until_ready()
-        box["state"] = st
-        return steps * batch_size / (time.perf_counter() - t0)
+    def step_once(i: int):
+        box["state"], valid = step(box["state"], keys_bufs[i % n_bufs],
+                                   bank_bufs[i % n_bufs], mask)
+        return valid
 
-    r = _run_converged(one_window)
+    r = _run_converged(lambda: _window_rate(
+        step_once, batch_size, max(seconds / 5, 0.05)))
 
     # Acceptance scalars in a FRESH SUBPROCESS: the deterministic
     # arange preload rebuilds the identical filter with a ~30-step
@@ -769,19 +777,11 @@ def bench_sharded_step(batch_size: int, seconds: float, capacity: int,
             pack_words(keys, banks, kw, padded)))
     valid = engine.step_words(bufs[0], batch_size, kw)
     valid.block_until_ready()
-    steps, t0 = 0, time.perf_counter()
-    while True:
-        valid = engine.step_words(bufs[steps % 8], batch_size, kw)
-        steps += 1
-        if steps % 50 == 0:
-            valid.block_until_ready()
-            if time.perf_counter() - t0 >= seconds:
-                break
-    valid.block_until_ready()
-    elapsed = time.perf_counter() - t0
+    rate = _window_rate(
+        lambda i: engine.step_words(bufs[i % 8], batch_size, kw),
+        batch_size, seconds)
     return {
-        "events_per_sec": steps * batch_size / elapsed,
-        "steps": steps, "batch_size": batch_size,
+        "events_per_sec": rate, "batch_size": batch_size,
         # Honest marker (VERDICT r04 weak #3): with one device the mesh
         # is (dp=1, sp=1) and the engine's degenerate-mesh build runs
         # the single-chip kernel suite (value-identical by construction,
@@ -1024,6 +1024,10 @@ def main() -> None:
                 "value": round(r["events_per_sec"], 1),
                 "unit": "keys/sec",
                 "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+                **{k: r[k] for k in
+                   ("rates", "converged", "tail_spread", "pass_walls_s",
+                    "pass_load1", "insert_rates", "insert_converged",
+                    "insert_tail_spread")},
                 "insert_keys_per_sec": round(r["insert_keys_per_sec"], 1),
             }
         elif args.mode == "hll":
@@ -1033,6 +1037,9 @@ def main() -> None:
                 "value": round(r["events_per_sec"], 1),
                 "unit": "events/sec",
                 "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
+                **{k: r[k] for k in
+                   ("rates", "converged", "tail_spread", "pass_walls_s",
+                    "pass_load1")},
                 "num_banks": r["num_banks"],
             }
         elif args.mode == "e2e":
@@ -1239,9 +1246,13 @@ def main() -> None:
                 "socket_events_per_sec": round(
                     sock["events_per_sec"], 1),
                 "socket_rates": sock["rates"],
+                "socket_converged": sock["converged"],
+                "socket_tail_spread": sock["tail_spread"],
                 "e2e_snapshot_events_per_sec": round(
                     snap["value"], 1),
                 "snapshot_rates": snap["rates"],
+                "snapshot_converged": snap["converged"],
+                "snapshot_tail_spread": snap["tail_spread"],
                 "snapshot_stall_s": snap["snapshot_stall_s"],
                 "snapshot_stall_max_s": snap["snapshot_stall_max_s"],
                 "snapshot_blocked_s": snap["snapshot_blocked_s"],
