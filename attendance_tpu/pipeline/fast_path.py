@@ -1102,6 +1102,13 @@ class FusedPipeline:
         events_path = self._snap_dir / EVENTS_SNAPSHOT
         if hasattr(self.store, "load_segments") and segs_dir.is_dir():
             self.store.truncate()
+            if hasattr(self.store, "compact_segments"):
+                # Compact BEFORE loading (restore is the safe point —
+                # no writer is running yet): a long run's cadence
+                # segments merge into one on disk, and the load below
+                # then reads that single file instead of parsing every
+                # segment twice.
+                self.store.compact_segments(segs_dir)
             self.store.load_segments(segs_dir)
         elif events_path.exists():
             self.store.truncate()

@@ -19,6 +19,7 @@ Cassandra last-write-wins.
 
 from __future__ import annotations
 
+import os
 import threading
 from pathlib import Path
 from typing import Dict, List
@@ -281,6 +282,57 @@ class ColumnarEventStore:
             self._saved_blocks = len(self._blocks)
             self._segment_seq = max(self._segment_seq, last_seq)
         return total
+
+    def compact_segments(self, dir_path, min_segments: int = 8) -> int:
+        """Merge every on-disk segment into ONE file and delete the
+        originals (no-op below ``min_segments``); returns segments
+        merged. Bounds restore cost for long-running checkpointed
+        deployments, whose cadence otherwise accumulates one file per
+        snapshot forever.
+
+        Crash-safe without coordination: the merged file is fsynced
+        and renamed into place (numbered after the highest existing
+        segment so later saves sort after it), and the directory entry
+        fsynced, BEFORE the originals are deleted — this is the one
+        path in the store that unlinks durable data, so page-cache
+        durability is not enough. A crash between the rename and the
+        unlinks leaves originals + merged coexisting; the merge DEDUPS
+        (same last-write-wins rule as the read path), so the next
+        compaction folds that overlap instead of compounding it, and
+        loads in between fold it at read time like replayed frames.
+        Callers must not run this concurrently with save_segments (the
+        pipeline compacts at restore time, before any writer starts)."""
+        dir_path = Path(dir_path)
+        paths = sorted(dir_path.glob("segment-*.npz"))
+        if len(paths) < max(min_segments, 2):
+            return 0
+        merged: Dict[str, List[np.ndarray]] = {n: [] for n in _COLS}
+        for p in paths:
+            with np.load(p) as data:
+                for name in _COLS:
+                    merged[name].append(data[name])
+        cols = {name: np.concatenate(arrs)
+                for name, arrs in merged.items()}
+        keep = self._dedup_keep(cols)
+        cols = {name: arr[keep] for name, arr in cols.items()}
+        last_seq = int(paths[-1].stem.split("-")[1])
+        out = dir_path / f"segment-{last_seq + 1:08d}.npz"
+        tmp = out.with_suffix(".tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **cols)
+            f.flush()
+            os.fsync(f.fileno())
+        tmp.replace(out)
+        dir_fd = os.open(dir_path, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+        for p in paths:
+            p.unlink()
+        with self._lock:
+            self._segment_seq = max(self._segment_seq, last_seq + 1)
+        return len(paths)
 
     def save(self, path) -> None:
         path = Path(path)
