@@ -399,6 +399,42 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
     return r
 
 
+JSON_ASSUMED_RATE = 1.5e6  # JSON decode is host-bound; sizes backlogs
+
+
+def _json_backlog(seconds: float, bridge_batch: int, cap: int) -> int:
+    """Backlog sizing shared by the memory- and socket-lane JSON
+    benches (same assumed rate, caller-specific cap), rounded to whole
+    bridge batches so every frame shares one padded shape."""
+    n = int(min(max(4 * bridge_batch, seconds * JSON_ASSUMED_RATE), cap))
+    return (n // bridge_batch) * bridge_batch
+
+
+def _json_payloads(rng, num_events: int, num_banks: int):
+    """(roster, per-event JSON payload list) in the reference's exact
+    wire shape (reference data_generator.py:112-123) — shared by the
+    memory-lane and socket-lane JSON benches."""
+    from attendance_tpu.pipeline.loadgen import synth_columns
+
+    roster = rng.choice(np.arange(10_000, 4_000_000, dtype=np.uint32),
+                        size=200_000, replace=False)
+    cols = synth_columns(rng, num_events, roster, num_lectures=num_banks,
+                         invalid_fraction=0.1)
+    hh = rng.integers(8, 18, num_events)
+    mm = rng.integers(0, 60, num_events)
+    ss = rng.integers(0, 60, num_events)
+    payloads = [
+        (b'{"student_id": %d, "timestamp": "2026-07-14T%02d:%02d:%02d", '
+         b'"lecture_id": "LECTURE_%d", "is_valid": %s, '
+         b'"event_type": "%s"}'
+         % (cols["student_id"][i], hh[i], mm[i], ss[i],
+            cols["lecture_day"][i],
+            b"true" if cols["is_valid"][i] else b"false",
+            b"exit" if cols["event_type"][i] else b"entry"))
+        for i in range(num_events)]
+    return roster, payloads
+
+
 def bench_json(seconds: float, capacity: int, num_banks: int,
                bridge_batch: int = 8192) -> dict:
     """JSON ingress end to end (VERDICT r02 #4): per-event JSON
@@ -417,32 +453,12 @@ def bench_json(seconds: float, capacity: int, num_banks: int,
     from attendance_tpu.config import Config
     from attendance_tpu.pipeline.bridge import JsonBinaryBridge
     from attendance_tpu.pipeline.fast_path import FusedPipeline
-    from attendance_tpu.pipeline.loadgen import synth_columns
     from attendance_tpu.transport.memory_broker import (
         MemoryBroker, MemoryClient)
 
     rng = np.random.default_rng(0)
-    assumed_rate = 1.5e6  # JSON decode is host-bound; sizes the backlog
-    num_events = int(min(max(4 * bridge_batch, seconds * assumed_rate),
-                         2_000_000))
-    num_events = (num_events // bridge_batch) * bridge_batch  # one shape
-
-    roster = rng.choice(np.arange(10_000, 4_000_000, dtype=np.uint32),
-                        size=200_000, replace=False)
-    cols = synth_columns(rng, num_events, roster, num_lectures=num_banks,
-                         invalid_fraction=0.1)
-    hh = rng.integers(8, 18, num_events)
-    mm = rng.integers(0, 60, num_events)
-    ss = rng.integers(0, 60, num_events)
-    payloads = [
-        (b'{"student_id": %d, "timestamp": "2026-07-14T%02d:%02d:%02d", '
-         b'"lecture_id": "LECTURE_%d", "is_valid": %s, '
-         b'"event_type": "%s"}'
-         % (cols["student_id"][i], hh[i], mm[i], ss[i],
-            cols["lecture_day"][i],
-            b"true" if cols["is_valid"][i] else b"false",
-            b"exit" if cols["event_type"][i] else b"entry"))
-        for i in range(num_events)]
+    num_events = _json_backlog(seconds, bridge_batch, 2_000_000)
+    roster, payloads = _json_payloads(rng, num_events, num_banks)
 
     config = Config(bloom_filter_capacity=capacity,
                     transport_backend="memory", batch_size=bridge_batch)
@@ -550,7 +566,67 @@ def bench_socket(batch_size: int, seconds: float, capacity: int,
             return pipe.metrics.events / pipe.metrics.wall_seconds
 
         r = _run_converged(one_pass, max_passes=6)
+
+        # JSON bridge lane over the SAME TCP broker (the reference's
+        # actual per-event wire, cross-process): JSON producer ->
+        # broker -> bridge (SocketClient) -> binary topic -> fused
+        # pipe (SocketClient). Own topic so the lanes don't mix.
+        import dataclasses
+
+        from attendance_tpu.pipeline.bridge import JsonBinaryBridge
+
+        rng = np.random.default_rng(0)
+        bridge_batch = 8192
+        # Same sizing as the memory lane, smaller cap (the backlog is
+        # re-shipped over TCP every pass).
+        jn = _json_backlog(seconds, bridge_batch, 1 << 20)
+        jroster, payloads = _json_payloads(rng, jn, num_banks)
+        jconfig = dataclasses.replace(
+            config, pulsar_topic=config.pulsar_topic + "-jsonlane",
+            batch_size=bridge_batch)
+        bridge = JsonBinaryBridge(jconfig, client=SocketClient(addr))
+        jpipe = FusedPipeline(
+            dataclasses.replace(jconfig, pulsar_topic=bridge.out_topic),
+            client=SocketClient(addr), num_banks=num_banks)
+        jpipe.preload(jroster)
+        jproducer = SocketClient(addr).create_producer(
+            jconfig.pulsar_topic)
+
+        def send_all() -> None:
+            for i in range(0, jn, bridge_batch):
+                jproducer.send_many(payloads[i:i + bridge_batch])
+
+        # Warmup: ONE bridge batch compiles the one padded shape.
+        jproducer.send_many(payloads[:bridge_batch])
+        bridge.run(max_events=bridge_batch, idle_timeout_s=0.5)
+        jpipe.run(max_events=bridge_batch, idle_timeout_s=0.5)
+        jpipe.store.truncate()
+
+        def json_pass() -> float:
+            send_all()
+            bridge.metrics.events = 0
+            jpipe.metrics.events = 0
+            bridge.run(max_events=jn, idle_timeout_s=5.0)
+            jpipe.run(max_events=jn, idle_timeout_s=5.0)
+            jpipe.store.truncate()
+            if bridge.metrics.dead_lettered or \
+                    jpipe.metrics.dead_lettered:
+                raise RuntimeError(
+                    f"socket JSON lane dead-lettered "
+                    f"{bridge.metrics.dead_lettered} payloads / "
+                    f"{jpipe.metrics.dead_lettered} frames — the "
+                    "bridge is broken, not slow")
+            wall = (bridge.metrics.wall_seconds
+                    + jpipe.metrics.wall_seconds)
+            return jn / wall if wall else 0.0
+
+        jr = _run_converged(json_pass, max_passes=5)
+
         r.update(events=num_events, batch_size=batch_size,
+                 json_events_per_sec=round(jr["events_per_sec"], 1),
+                 json_rates=jr["rates"],
+                 json_converged=jr["converged"],
+                 json_events=jn,
                  broker_address=addr, device=str(jax.devices()[0]))
         client.close()
         return r
@@ -1110,7 +1186,8 @@ def main() -> None:
                 "vs_baseline": round(_vs_baseline(r["events_per_sec"]), 4),
                 **{k: r[k] for k in
                    ("rates", "converged", "tail_spread", "pass_load1",
-                    "events", "batch_size", "device")},
+                    "events", "batch_size", "json_events_per_sec",
+                    "json_rates", "json_converged", "device")},
             }
         elif args.mode == "roster10m-accept":
             # Helper half of roster10m-tpu (own process: short journal).
@@ -1248,6 +1325,9 @@ def main() -> None:
                 "socket_rates": sock["rates"],
                 "socket_converged": sock["converged"],
                 "socket_tail_spread": sock["tail_spread"],
+                "socket_json_events_per_sec":
+                    sock["json_events_per_sec"],
+                "socket_json_converged": sock["json_converged"],
                 "e2e_snapshot_events_per_sec": round(
                     snap["value"], 1),
                 "snapshot_rates": snap["rates"],
