@@ -89,7 +89,8 @@ def _store_for_events_file(config, path: str):
     backend when the flag disagrees with the file."""
     from pathlib import Path
 
-    from attendance_tpu.pipeline.fast_path import EVENTS_SEGMENTS
+    from attendance_tpu.pipeline.fast_path import (
+        EVENTS_SEGMENTS, EVENTS_SNAPSHOT)
     from attendance_tpu.storage import make_event_store
 
     p = Path(path)
@@ -99,11 +100,14 @@ def _store_for_events_file(config, path: str):
             seg_dir = p
         elif (p / EVENTS_SEGMENTS).is_dir():
             seg_dir = p / EVENTS_SEGMENTS
-    elif (p.parent / EVENTS_SEGMENTS).is_dir():
-        # The legacy npz spelling resolves to the sibling segments dir
-        # even when the old file still EXISTS: a snapshot dir upgraded
-        # from the pre-segments format keeps writing new events to the
-        # segments only, so the stale npz must never shadow them.
+    elif (p.name == EVENTS_SNAPSHOT
+          and (p.parent / EVENTS_SEGMENTS).is_dir()):
+        # The FUSED legacy npz spelling resolves to the sibling
+        # segments dir even when the old file still EXISTS: a snapshot
+        # dir upgraded from the pre-segments format keeps writing new
+        # events to the segments only, so the stale npz must never
+        # shadow them. Other filenames (e.g. the generic processor's
+        # events file living in the same dir) keep their own format.
         seg_dir = p.parent / EVENTS_SEGMENTS
     if seg_dir is not None:
         from attendance_tpu.storage.columnar_store import (

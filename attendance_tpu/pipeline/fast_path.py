@@ -1331,5 +1331,9 @@ class FusedPipeline:
             for day, bank in self._bank_of.items()}
 
     def cleanup(self) -> None:
+        # Wait out any in-flight background snapshot before closing the
+        # transport it would ack through (the write itself is already
+        # durable either way; this just keeps the acks clean).
+        self._flush_snapshots()
         self.client.close()
         self.store.close()

@@ -38,6 +38,39 @@ def test_fused_subcommand(capsys):
     assert "Invalid Attendance Attempts" in out
 
 
+def test_events_file_resolver_scopes_segments_to_fused_name(tmp_path):
+    """Fused segments in a dir must override only the FUSED legacy npz
+    spelling — an explicitly named OTHER events file in the same dir
+    (e.g. the generic processor's) keeps its own content."""
+    import numpy as np
+
+    from attendance_tpu.cli import _store_for_events_file
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import EVENTS_SEGMENTS
+    from attendance_tpu.storage.columnar_store import ColumnarEventStore
+
+    def mkstore(sids):
+        s = ColumnarEventStore()
+        s.insert_columns({
+            "student_id": np.asarray(sids, np.uint32),
+            "lecture_day": np.full(len(sids), 20260101, np.uint32),
+            "micros": np.arange(len(sids), dtype=np.int64),
+            "is_valid": np.ones(len(sids), bool),
+            "event_type": np.zeros(len(sids), np.int8)})
+        return s
+
+    mkstore([1, 2, 3]).save_segments(tmp_path / EVENTS_SEGMENTS)
+    mkstore([7, 8]).save(tmp_path / "other_events.npz")
+
+    config = Config(storage_backend="columnar")
+    other = _store_for_events_file(config,
+                                   str(tmp_path / "other_events.npz"))
+    assert sorted(other.to_columns()["student_id"].tolist()) == [7, 8]
+    fused = _store_for_events_file(config,
+                                   str(tmp_path / "fused_events.npz"))
+    assert sorted(fused.to_columns()["student_id"].tolist()) == [1, 2, 3]
+
+
 def test_analyze_loads_columnar_events_file(tmp_path, capsys):
     """analyze --events-file must accept the fused pipeline's columnar
     npz snapshot, not just the row stores' JSONL format."""
