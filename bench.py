@@ -410,6 +410,14 @@ def _json_backlog(seconds: float, bridge_batch: int, cap: int) -> int:
     return (n // bridge_batch) * bridge_batch
 
 
+def _send_chunked(producer, payloads, batch: int) -> None:
+    """Publish at bridge-batch granularity (the deployment pattern):
+    every chunk receive is then a whole-block handover in the broker —
+    zero per-message work — instead of slicing one giant block."""
+    for i in range(0, len(payloads), batch):
+        producer.send_many(payloads[i:i + batch])
+
+
 def _json_payloads(rng, num_events: int, num_banks: int):
     """(roster, per-event JSON payload list) in the reference's exact
     wire shape (reference data_generator.py:112-123) — shared by the
@@ -478,7 +486,7 @@ def bench_json(seconds: float, capacity: int, num_banks: int,
     bridge_rates, pipe_rates = [], []
 
     def one_pass() -> float:
-        producer.send_many(payloads)
+        _send_chunked(producer, payloads, bridge_batch)
         bridge.metrics.events = 0
         pipe.metrics.events = 0
         bridge.run(max_events=num_events, idle_timeout_s=5.0)
@@ -592,10 +600,6 @@ def bench_socket(batch_size: int, seconds: float, capacity: int,
         jproducer = SocketClient(addr).create_producer(
             jconfig.pulsar_topic)
 
-        def send_all() -> None:
-            for i in range(0, jn, bridge_batch):
-                jproducer.send_many(payloads[i:i + bridge_batch])
-
         # Warmup: ONE bridge batch compiles the one padded shape.
         jproducer.send_many(payloads[:bridge_batch])
         bridge.run(max_events=bridge_batch, idle_timeout_s=0.5)
@@ -603,7 +607,7 @@ def bench_socket(batch_size: int, seconds: float, capacity: int,
         jpipe.store.truncate()
 
         def json_pass() -> float:
-            send_all()
+            _send_chunked(jproducer, payloads, bridge_batch)
             bridge.metrics.events = 0
             jpipe.metrics.events = 0
             bridge.run(max_events=jn, idle_timeout_s=5.0)
