@@ -875,11 +875,19 @@ def bench_sharded_step(batch_size: int, seconds: float, capacity: int,
     }
 
 
-def _probe_link_rate(seconds: float = 2.0) -> float:
+def _probe_link_rate_inprocess(seconds: float = 2.0) -> float:
     """Measured host->device transfer rate (bytes/sec) over ~64MB
     buffers — the resource the wire ladder trades against host pack
     cost. Varies multi-x with tunnel weather; recording it next to the
-    per-wire rates makes each wires-mode artifact interpretable."""
+    per-wire rates makes each wires-mode artifact interpretable.
+
+    CAUTION: poisons the calling process. The serialized 64MB raw
+    transfers flip the relay into a degraded transfer mode that cuts
+    subsequent PIPELINED H2D ~4x for tens of seconds (measured r05:
+    e2e 186M -> 48M ev/s after one probe in the same process, rates
+    slowly recovering across passes — the r04 artifact's 'ramping
+    warmup' and its 2-6.5x under-read of dedicated reruns were THIS).
+    Use _probe_link_rate (subprocess) before/next to measurements."""
     buf = np.random.default_rng(0).integers(
         0, 1 << 31, size=1 << 24, dtype=np.uint32)  # 64 MiB
     dev = jax.device_put(buf)
@@ -890,6 +898,30 @@ def _probe_link_rate(seconds: float = 2.0) -> float:
         jax.device_put(buf).block_until_ready()
         total += buf.nbytes
     return total / (time.perf_counter() - t0)
+
+
+def _probe_link_rate(seconds: float = 2.0) -> float:
+    """The link probe in a FRESH SUBPROCESS: attribution without
+    poisoning (see _probe_link_rate_inprocess). Falls back to the
+    in-process probe if the subprocess fails."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    if jax.default_backend() == "cpu":
+        env["ATP_BENCH_PLATFORM"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, str(Path(__file__).resolve()),
+             "--mode", "probe", "--seconds", str(seconds)],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=str(Path(__file__).resolve().parent))
+        if out.returncode == 0 and out.stdout.strip():
+            return float(json.loads(
+                out.stdout.strip().splitlines()[-1])["value"])
+    except Exception:
+        pass
+    return _probe_link_rate_inprocess(seconds)
 
 
 def bench_wires(seconds: float, capacity: int, num_banks: int,
@@ -1025,7 +1057,7 @@ def main() -> None:
                     choices=["both", "kernel", "e2e", "json", "wires",
                              "sharded", "bloom", "hll", "roster10m",
                              "roster10m-tpu", "roster10m-accept",
-                             "snapshot", "socket"],
+                             "snapshot", "socket", "probe"],
                     help="both/kernel/e2e are the headline benches; "
                     "json times the reference-wire JSON ingress "
                     "(bridge -> fused pipe); wires compares the forced "
@@ -1192,6 +1224,17 @@ def main() -> None:
                    ("rates", "converged", "tail_spread", "pass_load1",
                     "events", "batch_size", "json_events_per_sec",
                     "json_rates", "json_converged", "device")},
+            }
+        elif args.mode == "probe":
+            # Helper half of _probe_link_rate (own process: the raw
+            # transfers must not poison the measuring process).
+            line = {
+                "metric": "link_bytes_per_sec",
+                "value": round(
+                    _probe_link_rate_inprocess(min(args.seconds, 2.0)),
+                    1),
+                "unit": "bytes/sec",
+                "vs_baseline": 0.0,
             }
         elif args.mode == "roster10m-accept":
             # Helper half of roster10m-tpu (own process: short journal).
