@@ -736,24 +736,9 @@ def bench_roster10m_tpu(batch_size: int, seconds: float,
     # the reads before the windows would leave the windows measuring
     # the post-D2H collapsed dispatch mode instead of the device
     # program.
-    import subprocess
-    import sys
-
-    env = dict(os.environ)
-    if jax.default_backend() == "cpu":
-        # Hermetic (test) runs stay hermetic: the child must not fall
-        # through to the real device the parent was forced off of.
-        env["ATP_BENCH_PLATFORM"] = "cpu"
-    out = subprocess.run(
-        [sys.executable, str(Path(__file__).resolve()),
-         "--mode", "roster10m-accept", "--capacity", str(capacity)],
-        capture_output=True, text=True, timeout=600, env=env,
-        cwd=str(Path(__file__).resolve().parent))
-    if out.returncode != 0 or not out.stdout.strip():
-        raise RuntimeError(
-            f"roster10m-accept subprocess failed (rc={out.returncode}):"
-            f"\n{out.stderr[-4000:]}")
-    accept = json.loads(out.stdout.strip().splitlines()[-1])
+    accept = _bench_subprocess(
+        ["--mode", "roster10m-accept", "--capacity", str(capacity)],
+        timeout=600)
     fn = accept["false_negatives_of_100k"]
     fpr = accept["fpr_of_100k_disjoint"]
     fill = accept["fill_fraction"]
@@ -900,28 +885,49 @@ def _probe_link_rate_inprocess(seconds: float = 2.0) -> float:
     return total / (time.perf_counter() - t0)
 
 
-def _probe_link_rate(seconds: float = 2.0) -> float:
-    """The link probe in a FRESH SUBPROCESS: attribution without
-    poisoning (see _probe_link_rate_inprocess). Falls back to the
-    in-process probe if the subprocess fails."""
+def _bench_subprocess(mode_args: list, timeout: float) -> dict:
+    """Run ``bench.py <mode_args>`` in a fresh subprocess (pinning the
+    parent's forced platform for hermetic runs) and return its JSON
+    line; raises with the child's stderr tail on failure. The shared
+    launcher for every isolation helper (probe, snapshot section,
+    roster10m acceptance)."""
     import subprocess
     import sys
 
     env = dict(os.environ)
     if jax.default_backend() == "cpu":
         env["ATP_BENCH_PLATFORM"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, str(Path(__file__).resolve()), *mode_args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=str(Path(__file__).resolve().parent))
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError(
+            f"bench subprocess {mode_args} failed "
+            f"(rc={out.returncode}):\n{out.stderr[-4000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _probe_link_rate(seconds: float = 2.0):
+    """The link probe in a FRESH SUBPROCESS: attribution without
+    poisoning (see _probe_link_rate_inprocess). Returns
+    (bytes_per_sec, isolated) — ``isolated`` False means the
+    subprocess failed and the POISONING in-process fallback ran, so
+    sections measured after it in this process are suspect; artifacts
+    must carry the flag."""
+    import sys
+
     try:
-        out = subprocess.run(
-            [sys.executable, str(Path(__file__).resolve()),
-             "--mode", "probe", "--seconds", str(seconds)],
-            capture_output=True, text=True, timeout=120, env=env,
-            cwd=str(Path(__file__).resolve().parent))
-        if out.returncode == 0 and out.stdout.strip():
-            return float(json.loads(
-                out.stdout.strip().splitlines()[-1])["value"])
-    except Exception:
-        pass
-    return _probe_link_rate_inprocess(seconds)
+        line = _bench_subprocess(
+            ["--mode", "probe", "--seconds", str(min(seconds, 2.0))],
+            timeout=120)
+        return float(line["value"]), True
+    except Exception as exc:
+        print(f"[bench] WARNING: probe subprocess failed ({exc!r}); "
+              "falling back to the IN-PROCESS probe, which degrades "
+              "subsequent pipelined H2D in this process",
+              file=sys.stderr, flush=True)
+        return _probe_link_rate_inprocess(seconds), False
 
 
 def bench_wires(seconds: float, capacity: int, num_banks: int,
@@ -978,7 +984,7 @@ def bench_wires(seconds: float, capacity: int, num_banks: int,
             w: round(float(np.median(v)), 1) for w, v in rates.items()},
         "per_wire_all": {w: [round(x / 1e6, 2) for x in v]
                          for w, v in rates.items()},
-        "link_bytes_per_sec": round(_probe_link_rate(), 1),
+        "link_bytes_per_sec": round(_probe_link_rate()[0], 1),
         "events_per_frame": frame_size,
         "device": str(jax.devices()[0]),
     }
@@ -1289,21 +1295,28 @@ def main() -> None:
                       file=_sys.stderr, flush=True)
                 return out
 
-            links = {"e2e": _probe_link_rate()}
+            probe_ok: list = []
+
+            def probe() -> float:
+                rate, isolated = _probe_link_rate()
+                probe_ok.append(isolated)
+                return rate
+
+            links = {"e2e": probe()}
             e2e = _timed("e2e", bench_e2e, args.e2e_batch_size,
                          args.seconds, args.capacity, args.num_banks)
-            links["kernel"] = _probe_link_rate()
+            links["kernel"] = probe()
             kern = _timed("kernel", bench_fused_step, args.batch_size,
                           args.seconds, args.capacity, args.num_banks,
                           args.layout)
             # The reference's actual wire is per-event JSON — record its
             # ingress rate in every round's artifact (VERDICT r02 #4),
             # at a shorter window (it is host-bound and steadier).
-            links["json"] = _probe_link_rate()
+            links["json"] = probe()
             jsn = _timed("json", bench_json, min(args.seconds, 3.0),
                          args.capacity, args.num_banks)
             # TCP front (VERDICT r04 #4), short window.
-            links["socket"] = _probe_link_rate()
+            links["socket"] = probe()
             sock = _timed("socket", bench_socket, 1 << 17,
                           min(args.seconds, 3.0), args.capacity,
                           args.num_banks)
@@ -1314,32 +1327,14 @@ def main() -> None:
             # relay's whole deferred-dispatch journal (hours), and a
             # read before the other sections would leave them measuring
             # the post-D2H collapsed dispatch mode.
-            import subprocess
-            import sys
-
-            links["snapshot"] = _probe_link_rate()
-
-            def _snapshot_sub() -> dict:
-                env = dict(os.environ)
-                if jax.default_backend() == "cpu":
-                    env["ATP_BENCH_PLATFORM"] = "cpu"
-                out = subprocess.run(
-                    [sys.executable, str(Path(__file__).resolve()),
-                     "--mode", "snapshot",
-                     "--seconds", str(min(args.seconds, 2.0)),
-                     "--capacity", str(args.capacity),
-                     "--num-banks", str(args.num_banks),
-                     "--snapshot-every-batches",
-                     str(args.snapshot_every_batches)],
-                    capture_output=True, text=True, timeout=560,
-                    env=env, cwd=str(Path(__file__).resolve().parent))
-                if out.returncode != 0 or not out.stdout.strip():
-                    raise RuntimeError(
-                        f"snapshot subprocess failed "
-                        f"(rc={out.returncode}):\n{out.stderr[-4000:]}")
-                return json.loads(out.stdout.strip().splitlines()[-1])
-
-            snap = _timed("snapshot", _snapshot_sub)
+            links["snapshot"] = probe()
+            snap = _timed("snapshot", _bench_subprocess, [
+                "--mode", "snapshot",
+                "--seconds", str(min(args.seconds, 2.0)),
+                "--capacity", str(args.capacity),
+                "--num-banks", str(args.num_banks),
+                "--snapshot-every-batches",
+                str(args.snapshot_every_batches)], timeout=560)
             line = {
                 "metric": "e2e_pipeline_throughput",
                 "value": round(e2e["events_per_sec"], 1),
@@ -1349,6 +1344,7 @@ def main() -> None:
                 "wire": e2e["wire"],
                 "link_bytes_per_sec": {
                     k: round(v, 1) for k, v in links.items()},
+                "link_probes_isolated": all(probe_ok),
                 "e2e_rates": e2e["rates"],
                 "e2e_converged": e2e["converged"],
                 "e2e_tail_spread": e2e["tail_spread"],

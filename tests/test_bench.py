@@ -144,6 +144,9 @@ def test_main_emits_one_json_line(capsys, monkeypatch):
     # flags, the socket lane, and checkpointing-at-rate.
     assert set(line["link_bytes_per_sec"]) == \
         {"e2e", "kernel", "json", "socket", "snapshot"}
+    # Probes must have run isolated (subprocess) — the in-process
+    # fallback poisons the sections measured after it.
+    assert line["link_probes_isolated"] is True
     assert isinstance(line["e2e_converged"], bool)
     assert line["socket_events_per_sec"] > 0
     assert line["e2e_snapshot_events_per_sec"] > 0
