@@ -310,6 +310,29 @@ def cmd_pipeline(args) -> None:
     processor.cleanup()
 
 
+def cmd_telemetry(args) -> None:
+    """Pretty-print a telemetry artifact as a live-style table: a
+    flight-recorder JSON dump (``kill -USR1`` / crash / --flight-path)
+    or a Prometheus exposition file (--metrics-prom; the last scrape
+    block is shown). The format is sniffed from the file content."""
+    import sys
+
+    from attendance_tpu.obs.exposition import format_file
+
+    try:
+        print(format_file(args.path, last=args.last))
+    except FileNotFoundError:
+        logger.error("no such telemetry artifact: %s", args.path)
+        sys.exit(2)
+    except Exception as e:
+        # Truncated/hand-edited dumps and binary files must produce a
+        # diagnostic, not a traceback (same contract as the missing-
+        # file branch).
+        logger.error("unreadable telemetry artifact %s: %s",
+                     args.path, e)
+        sys.exit(2)
+
+
 def cmd_parity(args) -> None:
     """Differential tpu-vs-oracle parity run.
 
@@ -404,6 +427,14 @@ def main(argv=None) -> None:
     p_br.add_argument("--max-events", type=int, default=None)
     p_br.add_argument("--idle-timeout-s", type=float, default=1.0)
     p_br.set_defaults(fn=cmd_bridge)
+
+    p_tel = sub.add_parser(
+        "telemetry", help="pretty-print a flight-recorder dump or a "
+        "--metrics-prom exposition file as a live-style table")
+    p_tel.add_argument("path", help="flight dump JSON or prom text file")
+    p_tel.add_argument("--last", type=int, default=32,
+                       help="flight records shown (most recent)")
+    p_tel.set_defaults(fn=cmd_telemetry)
 
     p_par = sub.add_parser(
         "parity", help="differential tpu-vs-oracle accuracy check "

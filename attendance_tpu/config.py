@@ -108,6 +108,19 @@ class Config:
     # the processing run (TensorBoard/XProf-loadable). Device dispatches
     # are TraceAnnotation-labelled so kernel time attributes to stages.
     profile_dir: str = ""
+    # Live telemetry (obs/): all four default OFF, and with every flag
+    # unset the instrumented hot paths pay exactly one branch per event
+    # (same discipline as profile_dir). metrics_prom appends a
+    # Prometheus text-exposition block per interval to a file;
+    # metrics_port serves GET /metrics from a stdlib HTTP endpoint
+    # (-1 = ephemeral port, for tests/parallel runs); flight_recorder
+    # keeps a ring of the last N per-batch records, dumped as JSON to
+    # flight_path on SIGUSR1 / run-loop crash / the `telemetry` verb.
+    metrics_prom: str = ""
+    metrics_port: int = 0
+    metrics_interval_s: float = 1.0
+    flight_recorder: int = 0
+    flight_path: str = "flight_recorder.json"
     # Wire format for the fused pipeline's host->device transfer.
     # Either the link or the host-side pack is the e2e bottleneck,
     # depending on the moment's link rate vs host load; "auto" starts
@@ -147,6 +160,14 @@ class Config:
             raise ValueError(f"unknown replica sync: {self.replica_sync}")
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
+        if not (-1 <= self.metrics_port <= 65535):
+            raise ValueError(
+                f"metrics_port out of range: {self.metrics_port} "
+                "(0 = off, -1 = ephemeral)")
+        if self.metrics_interval_s <= 0:
+            raise ValueError("metrics_interval_s must be positive")
+        if self.flight_recorder < 0:
+            raise ValueError("flight_recorder must be >= 0 (ring size)")
         if self.invalid_topic and self.invalid_topic == self.pulsar_topic:
             # Republishing invalid events onto the processor's own
             # input topic would re-consume and republish them forever.
@@ -220,6 +241,21 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    help="write a jax.profiler trace of the run here")
     p.add_argument("--metrics-json", default=d.metrics_json,
                    help="append one JSON metrics line per run here")
+    p.add_argument("--metrics-prom", default=d.metrics_prom,
+                   help="append a Prometheus text-exposition block "
+                   "per interval to this file (live telemetry)")
+    p.add_argument("--metrics-port", type=int, default=d.metrics_port,
+                   help="serve GET /metrics on this port "
+                   "(0 = off, -1 = ephemeral)")
+    p.add_argument("--metrics-interval-s", type=float,
+                   default=d.metrics_interval_s,
+                   help="reporter cadence for --metrics-prom")
+    p.add_argument("--flight-recorder", type=int,
+                   default=d.flight_recorder,
+                   help="ring size of per-batch flight records "
+                   "(0 = off); dumped on SIGUSR1 or run-loop crash")
+    p.add_argument("--flight-path", default=d.flight_path,
+                   help="JSON dump path for the flight recorder")
     return p
 
 
@@ -253,4 +289,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
         max_redeliveries=args.max_redeliveries,
         profile_dir=args.profile_dir,
         metrics_json=args.metrics_json,
+        metrics_prom=args.metrics_prom,
+        metrics_port=args.metrics_port,
+        metrics_interval_s=args.metrics_interval_s,
+        flight_recorder=args.flight_recorder,
+        flight_path=args.flight_path,
     ).validate()

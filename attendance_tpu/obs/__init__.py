@@ -1,0 +1,199 @@
+"""Live telemetry subsystem: registry + exposition + flight recorder.
+
+One process-wide :class:`Telemetry` instance (module global), enabled
+iff any telemetry flag is set (``--metrics-prom``, ``--metrics-port``,
+``--flight-recorder``). Instrumented call sites follow the
+``utils/profiling.py`` discipline: they capture the global ONCE at
+construction and pay exactly one ``is not None`` branch per hot-path
+event when telemetry is off — nothing is imported, timed, or allocated.
+
+Wiring: pipeline constructors call :func:`ensure` with their config —
+first caller with a telemetry-enabled config creates and starts the
+subsystem; everyone after (brokers, engines, sibling pipelines in the
+same process) picks it up via :func:`get`. Tests drive
+:func:`enable`/:func:`disable` directly.
+
+Metric names (the stable scrape contract, asserted by tests):
+
+* ``attendance_events_total`` / ``attendance_frames_total`` — counters
+  over both processors.
+* ``attendance_wire_frames_total{wire=...}`` — frames per host->device
+  wire (word/seg/delta/bytes/arrays), the adaptive ladder made visible.
+* ``attendance_stage_latency_seconds{stage=...}`` — log-bucketed
+  per-stage histograms (dequeue_wait, decode, dispatch, device_wait,
+  batch_assembly, sketch, persist, snapshot_write, snapshot_blocked).
+* ``attendance_queue_depth{topic=...,subscription=...}`` — broker
+  backlog gauges (callback-read at scrape time).
+* ``attendance_broker_*`` / ``attendance_socket_*`` — transport
+  counters (messages, bytes, redeliveries).
+* ``attendance_shard_events{replica=...}`` — per-replica event totals
+  of the sharded engine, aggregated at report time.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+from attendance_tpu.obs.recorder import (  # noqa: F401
+    _NOT_INSTALLED, DEFAULT_RING, FlightRecorder, install_sigusr1,
+    uninstall_sigusr1)
+from attendance_tpu.obs.registry import (  # noqa: F401
+    Counter, Gauge, Histogram, Registry)
+
+logger = logging.getLogger(__name__)
+
+# THE process-wide telemetry handle. None = disabled (the common case):
+# every instrumented call site short-circuits on it.
+TELEMETRY: Optional["Telemetry"] = None
+_lock = threading.Lock()
+
+DEFAULT_FLIGHT_PATH = "flight_recorder.json"
+
+
+def enabled_in(config) -> bool:
+    """Does this config ask for live telemetry at all?"""
+    return bool(getattr(config, "metrics_prom", "")
+                or getattr(config, "metrics_port", 0)
+                or getattr(config, "flight_recorder", 0))
+
+
+class Telemetry:
+    """Registry + optional reporter/server/flight-recorder, one bundle."""
+
+    def __init__(self, *, metrics_prom: str = "", metrics_port: int = 0,
+                 metrics_interval_s: float = 1.0,
+                 flight_recorder: int = 0,
+                 flight_path: str = DEFAULT_FLIGHT_PATH):
+        self.registry = Registry()
+        self.flight: Optional[FlightRecorder] = (
+            FlightRecorder(flight_recorder) if flight_recorder > 0
+            else None)
+        self.flight_path = flight_path or DEFAULT_FLIGHT_PATH
+        self._reporter = None
+        self._server = None
+        self._prev_sigusr1 = _NOT_INSTALLED
+        self._metrics_prom = metrics_prom
+        self._metrics_port = metrics_port
+        self._interval = metrics_interval_s
+        self._stage_cache: Dict[str, Histogram] = {}
+        self._wire_cache: Dict[str, Counter] = {}
+        # The shared top-line counters both processors bump.
+        self.events = self.registry.counter(
+            "attendance_events_total", help="Events processed")
+        self.frames = self.registry.counter(
+            "attendance_frames_total", help="Frames/batches processed")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "Telemetry":
+        from attendance_tpu.obs.exposition import (
+            FileReporter, MetricsServer)
+        if self._metrics_prom:
+            self._reporter = FileReporter(
+                self.registry, self._metrics_prom, self._interval).start()
+        if self._metrics_port:
+            # -1 selects an ephemeral port (tests, parallel runs); the
+            # bound port is on server.port either way.
+            port = 0 if self._metrics_port < 0 else self._metrics_port
+            self._server = MetricsServer(self.registry, port).start()
+        if self.flight is not None:
+            self._prev_sigusr1 = install_sigusr1(self.flight,
+                                                 self.flight_path)
+        return self
+
+    def stop(self) -> None:
+        if self._reporter is not None:
+            self._reporter.stop()
+            self._reporter = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+        if self._prev_sigusr1 is not _NOT_INSTALLED:
+            # Restore the displaced handler: a leaked one would keep
+            # dumping this (now stale) ring to this (now stale) path.
+            uninstall_sigusr1(self._prev_sigusr1)
+            self._prev_sigusr1 = _NOT_INSTALLED
+
+    @property
+    def http_port(self) -> Optional[int]:
+        return self._server.port if self._server is not None else None
+
+    # -- cached handles (hot paths fetch these once at construction) ---------
+    def stage(self, name: str) -> Histogram:
+        h = self._stage_cache.get(name)
+        if h is None:
+            h = self._stage_cache[name] = self.registry.histogram(
+                "attendance_stage_latency_seconds",
+                help="Per-stage latency (power-of-2 buckets)",
+                stage=name)
+        return h
+
+    def wire(self, name: str) -> Counter:
+        c = self._wire_cache.get(name)
+        if c is None:
+            c = self._wire_cache[name] = self.registry.counter(
+                "attendance_wire_frames_total",
+                help="Frames dispatched per host->device wire",
+                wire=name)
+        return c
+
+    # -- flight recorder -----------------------------------------------------
+    def record_batch(self, **fields) -> None:
+        if self.flight is not None:
+            self.flight.record(fields)
+
+    def dump_flight(self, reason: str) -> None:
+        if self.flight is None:
+            return
+        try:
+            p = self.flight.dump(self.flight_path, reason=reason)
+            logger.info("Flight recorder dumped to %s (%s)", p, reason)
+        except Exception:
+            logger.exception("Flight recorder dump failed")
+
+    def render(self) -> str:
+        from attendance_tpu.obs.exposition import render
+        return render(self.registry)
+
+
+def enable(config) -> Telemetry:
+    """Create, start, and install the global Telemetry from config."""
+    global TELEMETRY
+    with _lock:
+        if TELEMETRY is not None:
+            return TELEMETRY
+        t = Telemetry(
+            metrics_prom=getattr(config, "metrics_prom", ""),
+            metrics_port=getattr(config, "metrics_port", 0),
+            metrics_interval_s=getattr(config, "metrics_interval_s", 1.0),
+            flight_recorder=getattr(config, "flight_recorder", 0),
+            flight_path=getattr(config, "flight_path",
+                                DEFAULT_FLIGHT_PATH))
+        t.start()
+        TELEMETRY = t
+        return t
+
+
+def ensure(config) -> Optional[Telemetry]:
+    """The constructor chokepoint: returns the live global telemetry,
+    creating it iff this config enables any telemetry surface. With all
+    flags unset this is one global read — the disabled path."""
+    if TELEMETRY is not None:
+        return TELEMETRY
+    if config is not None and enabled_in(config):
+        return enable(config)
+    return None
+
+
+def get() -> Optional[Telemetry]:
+    return TELEMETRY
+
+
+def disable() -> None:
+    """Stop and clear the global (tests; symmetric with enable)."""
+    global TELEMETRY
+    with _lock:
+        if TELEMETRY is not None:
+            TELEMETRY.stop()
+            TELEMETRY = None

@@ -1,0 +1,258 @@
+"""Prometheus text exposition + the two delivery surfaces.
+
+``render`` turns a Registry into Prometheus text-exposition format
+(version 0.0.4 — the format every scraper and promtool parses). Two
+delivery modes, both off the hot path:
+
+* :class:`FileReporter` — a background thread appending one rendered
+  block per interval to a file (``--metrics-prom``), each prefixed with
+  a ``# scrape <unix_ts>`` marker so consumers (and the CLI
+  ``telemetry`` verb) can split blocks.
+* :class:`MetricsServer` — a stdlib ThreadingHTTPServer answering
+  ``GET /metrics`` with a fresh render (``--metrics-port``); no
+  third-party dependency, matching the container constraint.
+
+Also home of the ``telemetry`` CLI verb's table formatters: a prom file
+or a flight-recorder dump pretty-printed as a live-style table.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from attendance_tpu.obs.registry import (
+    Counter, Gauge, Histogram, NUM_BUCKETS, Registry)
+
+logger = logging.getLogger(__name__)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _fmt(v) -> str:
+    """Prometheus sample value: integers bare, floats via repr (both
+    are valid exposition floats; bare ints keep counters exact)."""
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _labels(items, extra: str = "") -> str:
+    parts = [f'{k}="{_escape(v)}"' for k, v in items]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def render(registry: Registry) -> str:
+    """Registry -> Prometheus text exposition (one trailing newline)."""
+    lines: List[str] = []
+    for name, kind, help, members in registry.collect():
+        if help:
+            lines.append(f"# HELP {name} {_escape(help)}")
+        lines.append(f"# TYPE {name} {kind}")
+        for m in members:
+            if isinstance(m, Counter):
+                lines.append(f"{name}{_labels(m.labels)} {_fmt(m.value)}")
+            elif isinstance(m, Gauge):
+                lines.append(f"{name}{_labels(m.labels)} {_fmt(m.value)}")
+            elif isinstance(m, Histogram):
+                buckets, total, count = m.snapshot()
+                cum = 0
+                for i in range(NUM_BUCKETS):
+                    cum += buckets[i]
+                    le = 'le="%s"' % _fmt(m.bucket_bound(i))
+                    lines.append(
+                        f"{name}_bucket{_labels(m.labels, le)} {cum}")
+                inf = 'le="+Inf"'
+                lines.append(
+                    f"{name}_bucket{_labels(m.labels, inf)} {count}")
+                lines.append(f"{name}_sum{_labels(m.labels)} {_fmt(total)}")
+                lines.append(f"{name}_count{_labels(m.labels)} {count}")
+    return "\n".join(lines) + "\n"
+
+
+class FileReporter:
+    """Append a rendered block to ``path`` every ``interval_s``."""
+
+    def __init__(self, registry: Registry, path: str,
+                 interval_s: float = 1.0):
+        self.registry = registry
+        self.path = path
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="metrics-reporter", daemon=True)
+
+    def start(self) -> "FileReporter":
+        self._thread.start()
+        return self
+
+    def _write_block(self) -> None:
+        block = f"# scrape {time.time():.3f}\n" + render(self.registry)
+        with open(self.path, "a") as f:
+            f.write(block)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._write_block()
+            except Exception:
+                logger.exception("metrics reporter write failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        try:
+            self._write_block()  # final block: short runs still report
+        except Exception:
+            logger.exception("metrics reporter final write failed")
+
+
+class MetricsServer:
+    """``GET /metrics`` over stdlib http.server; port 0 = ephemeral
+    (the bound port is exposed as ``.port``)."""
+
+    def __init__(self, registry: Registry, port: int,
+                 host: str = "127.0.0.1"):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                if self.path not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = render(outer.registry).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not log lines
+                pass
+
+        self.registry = registry
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-http",
+            daemon=True)
+
+    def start(self) -> "MetricsServer":
+        self._thread.start()
+        logger.info("Serving Prometheus metrics on :%d/metrics",
+                    self.port)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+# -- CLI table formatting ----------------------------------------------------
+
+def parse_prom(text: str):
+    """Samples of the LAST scrape block: [(name, labels_str, value)].
+    Accepts both reporter files (multiple ``# scrape`` blocks) and a
+    single raw exposition."""
+    blocks = text.split("# scrape ")
+    last = blocks[-1]
+    if len(blocks) > 1:  # drop the timestamp line of the marker
+        last = last.split("\n", 1)[1] if "\n" in last else ""
+    samples = []
+    for line in last.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            metric, value = line.rsplit(" ", 1)
+        except ValueError:
+            continue
+        if "{" in metric:
+            name, rest = metric.split("{", 1)
+            labels = rest.rstrip("}")
+        else:
+            name, labels = metric, ""
+        samples.append((name, labels, value))
+    return samples
+
+
+def _table(rows: List[List[str]], headers: List[str]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    out = [fmt.format(*headers), fmt.format(*("-" * w for w in widths))]
+    out.extend(fmt.format(*r) for r in rows)
+    return "\n".join(out)
+
+
+def format_prom_table(text: str) -> str:
+    """Live-style table of the last scrape block of a prom file.
+    Histograms are folded to count/sum/mean — the raw buckets stay in
+    the file for machine consumers."""
+    samples = parse_prom(text)
+    hist: dict = {}
+    rows = []
+    for name, labels, value in samples:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[:-len(suffix)]
+                key_labels = ",".join(
+                    p for p in labels.split(",") if not
+                    p.startswith("le=")) if suffix == "_bucket" else labels
+                h = hist.setdefault((base, key_labels), {})
+                if suffix != "_bucket":
+                    h[suffix] = value
+                break
+        else:
+            rows.append([name, labels, value])
+    for (base, labels), h in sorted(hist.items()):
+        count = float(h.get("_count", 0) or 0)
+        total = float(h.get("_sum", 0) or 0)
+        mean = f"{total / count:.6g}" if count else "n/a"
+        rows.append([base, labels,
+                     f"count={int(count)} sum={total:.6g} mean={mean}"])
+    rows.sort()
+    return _table(rows, ["metric", "labels", "value"])
+
+
+def format_flight_table(doc: dict, last: int = 32) -> str:
+    """Flight-recorder dump -> table of the most recent records."""
+    records = doc.get("records", [])[-last:]
+    cols: List[str] = []
+    for r in records:
+        for k in r:
+            if k not in cols:
+                cols.append(k)
+    rows = [[str(r.get(c, "")) for c in cols] for r in records]
+    head = (f"flight recorder dump: reason={doc.get('reason')} "
+            f"pid={doc.get('pid')} total_records="
+            f"{doc.get('total_records')} ring={doc.get('ring_size')} "
+            f"(showing last {len(records)})")
+    return head + "\n" + _table(rows, cols or ["(empty)"])
+
+
+def format_file(path: str, last: int = 32) -> str:
+    """Sniff ``path`` (flight-dump JSON vs prom text) and format it."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        return format_flight_table(json.loads(text), last=last)
+    return format_prom_table(text)

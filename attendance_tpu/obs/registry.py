@@ -1,0 +1,232 @@
+"""Metrics registry: named Counters, Gauges, and log-bucketed Histograms.
+
+The live half of the observability story (SURVEY.md §5): where
+``ProcessorMetrics`` is an end-of-run artifact, these metrics are
+readable at any moment by the exposition layer (obs.exposition) without
+stopping or perturbing the hot loop.
+
+Design constraints, in order:
+
+* Hot-path record cost is an increment plus a bit-scan. A histogram
+  ``observe`` scales the value to integer units and buckets it by
+  ``int.bit_length()`` (power-of-2 bucket boundaries) — no bisect, no
+  float log. The only synchronization is one per-metric mutex held for
+  the increment itself; metrics never share a lock, so two pipeline
+  threads recording different stages never contend.
+* Disabled cost is zero: nothing in this module runs unless telemetry
+  was enabled — instrumented call sites hold ``None`` and pay one
+  branch (the ``utils/profiling.py`` discipline).
+* Collection is lock-consistent per metric, not globally atomic: a
+  scrape sees each metric at some point during the scrape, exactly like
+  a Prometheus client library.
+
+Identity is (name, sorted label items): asking the registry for the
+same name+labels returns the same metric object, so call sites may
+re-request handles without double-counting.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+# Histogram geometry: bucket i counts observations whose scaled value u
+# satisfies u.bit_length() == i, i.e. u < 2**i — upper bound 2**i units.
+# 28 buckets at microsecond scale span 1us .. ~134s, which brackets every
+# stage latency this framework can produce (a snapshot stall measured in
+# seconds sits mid-range).
+NUM_BUCKETS = 28
+
+
+class Counter:
+    """Monotonic counter. ``inc`` of a negative amount raises — the
+    monotonicity contract is what lets consumers compute rates."""
+
+    __slots__ = ("name", "labels", "help", "_lock", "_value")
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...],
+                 help: str = ""):
+        self.name = name
+        self.labels = labels
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, amount: int = 1) -> None:
+        if amount < 0:
+            raise ValueError(
+                f"counter {self.name} cannot decrease (inc {amount})")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Set/add gauge, or a callback gauge (``set_function``) whose value
+    is read lazily at collection time — queue depths cost the hot path
+    nothing this way; only the scrape pays the read."""
+
+    __slots__ = ("name", "labels", "help", "_lock", "_value", "_fn")
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...],
+                 help: str = ""):
+        self.name = name
+        self.labels = labels
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+            self._fn = None
+
+    def add(self, delta: float) -> None:
+        with self._lock:
+            self._value += delta
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception:
+            # A dead callback (e.g. its subscription was torn down) must
+            # not break every future scrape.
+            return 0.0
+
+
+class Histogram:
+    """Log-bucketed (power-of-2) histogram.
+
+    ``scale`` converts observed values to integer bucket units before
+    the bit-scan; the default 1e6 gives microsecond-resolution buckets
+    for values observed in seconds. Upper bound of bucket i is
+    ``2**i / scale`` (in observed units); the last bucket is +Inf.
+    """
+
+    __slots__ = ("name", "labels", "help", "scale", "_lock", "_buckets",
+                 "_overflow", "_sum", "_count")
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...],
+                 help: str = "", scale: float = 1e6):
+        self.name = name
+        self.labels = labels
+        self.help = help
+        self.scale = scale
+        self._lock = threading.Lock()
+        self._buckets = [0] * NUM_BUCKETS
+        # Samples past the last finite bound count ONLY toward +Inf
+        # (and sum/count): folding them into the last finite bucket
+        # would claim e.g. a 10-minute stall was <= 134s — exactly the
+        # forensic lie cumulative-bucket semantics exist to prevent.
+        self._overflow = 0
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        u = int(value * self.scale)
+        idx = u.bit_length() if u > 0 else 0
+        with self._lock:
+            if idx >= NUM_BUCKETS:
+                self._overflow += 1
+            else:
+                self._buckets[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    def bucket_bound(self, idx: int) -> float:
+        """Upper bound (observed units) of bucket ``idx``."""
+        return (1 << idx) / self.scale
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        with self._lock:
+            return list(self._buckets), self._sum, self._count
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Registry:
+    """Get-or-create registry of metrics keyed by (name, labels)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple, object] = {}
+        # name -> (kind, help), pinned by the first registration so a
+        # later get with a different kind fails loudly instead of
+        # corrupting the exposition.
+        self._families: Dict[str, Tuple[str, str]] = {}
+
+    def _get(self, kind: str, cls, name: str, help: str,
+             labels: Dict[str, str], **kwargs):
+        key = (name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is not None:
+                if self._families[name][0] != kind:
+                    raise ValueError(
+                        f"metric {name} already registered as "
+                        f"{self._families[name][0]}, not {kind}")
+                return m
+            fam = self._families.get(name)
+            if fam is not None and fam[0] != kind:
+                raise ValueError(
+                    f"metric {name} already registered as {fam[0]}, "
+                    f"not {kind}")
+            if fam is None:
+                self._families[name] = (kind, help)
+            m = cls(name, key[1], help=help or (fam[1] if fam else ""),
+                    **kwargs)
+            self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        return self._get("counter", Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        return self._get("gauge", Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "", scale: float = 1e6,
+                  **labels) -> Histogram:
+        return self._get("histogram", Histogram, name, help, labels,
+                         scale=scale)
+
+    def collect(self):
+        """(name, kind, help, [metrics]) families, sorted by name —
+        deterministic order keeps the exposition golden-testable."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+            families = dict(self._families)
+        by_name: Dict[str, list] = {}
+        for m in metrics:
+            by_name.setdefault(m.name, []).append(m)
+        out = []
+        for name in sorted(by_name):
+            kind, help = families[name]
+            members = sorted(by_name[name], key=lambda m: m.labels)
+            out.append((name, kind, help, members))
+        return out

@@ -44,6 +44,7 @@ traffic stays local and only the 1-byte-per-key answer rides ICI.
 
 from __future__ import annotations
 
+import weakref
 from typing import Optional, Tuple
 
 import jax
@@ -58,6 +59,27 @@ from attendance_tpu.models.fused import (
     _bump_counts, decode_delta_lanes, decode_seg_lanes)
 from attendance_tpu.models.hll import (
     estimate_from_histogram, hll_bucket_rank)
+
+
+def _shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across JAX versions: the public API when present,
+    else the experimental one (same semantics; check_vma was spelled
+    check_rep there)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check_vma)
+        except AttributeError:
+            pass  # a deprecation stub re-raising: fall through
+    from jax.experimental.shard_map import shard_map as exp_sm
+    return exp_sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+
+
+# Engines with live telemetry, for the report-time gauge aggregation
+# (weak: a collected engine drops out of every scrape automatically).
+_LIVE_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def make_mesh(num_shards: int = 1, num_replicas: int = 1,
@@ -113,6 +135,34 @@ class ShardedSketchEngine:
             raise ValueError(f"sp={self.sp} must divide {self.m_regs}")
         self.num_banks = num_banks
         self._word_step_cache = {}
+        # Per-replica event counters, host-side: the hot path pays one
+        # numpy add per step; report-time aggregation happens through
+        # callback gauges registered with the live telemetry (obs/),
+        # read only when a scrape renders the registry.
+        self.shard_events = np.zeros(self.dp, np.int64)
+        from attendance_tpu import obs
+        _t = obs.get()
+        # Tracking is gated on telemetry being live at construction:
+        # with the flags unset the step hooks below must stay one
+        # branch (the documented disabled-path guarantee) — counters
+        # nobody can scrape are pure cost.
+        self._obs_enabled = _t is not None
+        if _t is not None:
+            # The gauge callbacks aggregate over a WeakSet of live
+            # engines: sibling engines in one process (an explicitly
+            # supported shape) must SUM per replica, not last-writer-
+            # wins, and a dead engine must neither be pinned by its
+            # closure nor keep reporting.
+            _LIVE_ENGINES.add(self)
+            for r in range(self.dp):
+                _t.registry.gauge(
+                    "attendance_shard_events",
+                    help="Events dispatched to each dp replica slice "
+                    "(summed over live engines)",
+                    replica=str(r)).set_function(
+                        lambda r=r: sum(
+                            int(e.shard_events[r])
+                            for e in list(_LIVE_ENGINES) if r < e.dp))
         # Degenerate-mesh specialization: on a ONE-device mesh every
         # collective is an identity and the partitioned program is
         # value-identical to the plain single-chip program — so the
@@ -449,7 +499,7 @@ class ShardedSketchEngine:
         # union filters, pmin + tiled all_gather replication, psum of
         # dp-replicated popcounts).
         def wrap(fn, in_specs, out_specs, donate_argnums=()):
-            return jax.jit(jax.shard_map(
+            return jax.jit(_shard_map(
                 fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False), donate_argnums=donate_argnums)
 
@@ -519,17 +569,38 @@ class ShardedSketchEngine:
             lambda bits, c: self._preload(bits, c, mask),
             self.bits, keys, chunk=chunk)
 
+    def _note_events(self, n: int, padded: int) -> None:
+        """Attribute a batch's n real events to the contiguous dp range
+        slices that carry them (the batch axis splits evenly). One
+        branch when telemetry is off."""
+        if not self._obs_enabled:
+            return
+        local = padded // self.dp
+        for r in range(self.dp):
+            c = n - r * local
+            if c <= 0:
+                break
+            self.shard_events[r] += min(c, local)
+
     def step_words(self, words, n: int, kw: int) -> jax.Array:
         """Fused validate+count over the packed word wire; ``words`` is
         already padded (pad lanes = 0xFFFFFFFF) to a dp multiple.
         Returns validity[:n] (async device array, like :meth:`step`).
         One compiled program per key width, cached."""
+        self._note_events(n, len(words))
         step = self._word_step_cache.get(kw)
         if step is None:
             step = self._word_step_cache[kw] = self._make_step_words(kw)
         valid, self.regs, self.counts = step(
             self.bits, self.regs, self.counts, jnp.asarray(words))
         return valid[:n]
+
+    def note_shard_events(self, lane_counts) -> None:
+        """Attribute externally-packed per-replica event counts (the
+        narrow wires pack per-slice in the pipeline, so the engine
+        cannot derive real-lane counts from the buffer)."""
+        if self._obs_enabled:
+            self.shard_events += np.asarray(lane_counts, np.int64)
 
     def step_narrow(self, bufs: np.ndarray, mode: str, width: int,
                     padded_local: int) -> jax.Array:
@@ -563,6 +634,7 @@ class ShardedSketchEngine:
         keys = np.asarray(keys, dtype=np.uint32)
         bank_idx = np.asarray(bank_idx, dtype=np.int32)
         kbuf, n = self._pad(keys, 0, np.uint32)
+        self._note_events(n, len(kbuf))
         bbuf, _ = self._pad(bank_idx, -1, np.int32)
         mask = np.zeros(len(kbuf), dtype=bool)
         mask[:n] = True

@@ -100,7 +100,24 @@ def _iso_to_micros(ts: str) -> int:
     # fractional timestamps one microsecond low, which would diverge
     # from the native scanner's exact arithmetic (hostpipe.c
     # parse_iso_micros) and break replay/dedup equality across paths.
-    dt = datetime.fromisoformat(ts)
+    try:
+        dt = datetime.fromisoformat(ts)
+    except ValueError:
+        # Python < 3.11 fromisoformat accepts only 3- or 6-digit
+        # fractions and no 'Z' suffix, while the event wire allows any
+        # fraction width (hostpipe.c parse_iso_micros). Normalize:
+        # Z -> +00:00, fraction padded/truncated to exactly 6 digits
+        # (pure decimal shift — same integer micros as the native
+        # scanner's exact arithmetic).
+        norm = ts[:-1] + "+00:00" if ts.endswith("Z") else ts
+        i = norm.find(".")
+        if i != -1:
+            j = i + 1
+            while j < len(norm) and norm[j].isdigit():
+                j += 1
+            frac = norm[i + 1:j][:6].ljust(6, "0")
+            norm = norm[:i + 1] + frac + norm[j:]
+        dt = datetime.fromisoformat(norm)
     if dt.tzinfo is None:
         dt = dt.replace(tzinfo=timezone.utc)
     return (dt - _EPOCH) // timedelta(microseconds=1)

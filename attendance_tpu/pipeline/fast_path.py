@@ -54,6 +54,7 @@ from typing import Dict, Optional
 import jax
 import numpy as np
 
+from attendance_tpu import obs
 from attendance_tpu.config import Config
 from attendance_tpu.models.bloom import bloom_add_packed
 from attendance_tpu.models.fused import (
@@ -127,6 +128,19 @@ class FusedPipeline:
                  client=None, store: Optional[ColumnarEventStore] = None,
                  num_banks: int = 256, mesh=None):
         self.config = config or Config()
+        # Live telemetry (obs/): created here iff a telemetry flag is
+        # set, BEFORE the transport below so broker queues register
+        # their depth gauges. With the flags unset every hook in this
+        # class is one `is not None` branch (profiling.py discipline).
+        self._obs = obs.ensure(self.config)
+        if self._obs is not None:
+            self._h_dequeue = self._obs.stage("dequeue_wait")
+            self._h_decode = self._obs.stage("decode")
+            self._h_dispatch = self._obs.stage("dispatch")
+            self._h_device = self._obs.stage("device_wait")
+            self._h_snap_write = self._obs.stage("snapshot_write")
+            self._h_snap_blocked = self._obs.stage("snapshot_blocked")
+        self._last_wire = ""
         self.client = client or make_client(self.config)
         self.consumer = self.client.subscribe(
             self.config.pulsar_topic, self.SUBSCRIPTION)
@@ -355,10 +369,12 @@ class FusedPipeline:
     # -- hot loop -----------------------------------------------------------
     def process_frame(self, data: bytes):
         """Dispatch one bulk binary frame; returns the async validity."""
+        obs_t = self._obs
         t0 = time.perf_counter()
         # Skip the embedded ground-truth column: validity is recomputed
         # on device and the store gets the computed vector.
         cols = decode_binary_batch(data, include_truth=False)
+        t_dec = time.perf_counter() if obs_t is not None else 0.0
         n = len(cols["student_id"])
         if n == 0:
             return None
@@ -420,7 +436,19 @@ class FusedPipeline:
         self.metrics.batches += 1
         self.metrics.events += n
         self.metrics.batch_sizes.append(n)
-        self.metrics.device_seconds += time.perf_counter() - t0
+        t_end = time.perf_counter()
+        self.metrics.device_seconds += t_end - t0
+        if obs_t is not None:
+            self._h_decode.observe(t_dec - t0)
+            self._h_dispatch.observe(t_end - t_dec)
+            obs_t.events.inc(n)
+            obs_t.frames.inc()
+            obs_t.record_batch(
+                ts=round(time.time(), 6), events=n,
+                wire=self._last_wire,
+                decode_s=round(t_dec - t0, 6),
+                dispatch_s=round(t_end - t_dec, 6),
+                inflight=len(self._inflight))
         return valid_n
 
     def _word_step(self, kw: int):
@@ -731,6 +759,9 @@ class FusedPipeline:
             orig[pos:pos + m] = bounds[r] + perm
             pos += m
         self._count_wire(mode)
+        if self._obs is not None:
+            engine.note_shard_events(
+                [bounds[r + 1] - bounds[r] for r in range(dp)])
         valid = engine.step_narrow(bufs, mode, width, padded_local)
         return valid, lanes, orig
 
@@ -758,6 +789,9 @@ class FusedPipeline:
         attributed to the wire that actually carried them."""
         dwell = self.metrics.wire_dwell
         dwell[key] = dwell.get(key, 0) + 1
+        self._last_wire = key
+        if self._obs is not None:
+            self._obs.wire(key).inc()
 
     def _auto_wire(self) -> str:
         """Per-frame wire choice for auto mode, from observed
@@ -971,7 +1005,10 @@ class FusedPipeline:
         if t is not None and t.is_alive():
             t0 = time.perf_counter()
             t.join()
-            self.metrics.snapshot_blocked_s += time.perf_counter() - t0
+            blocked = time.perf_counter() - t0
+            self.metrics.snapshot_blocked_s += blocked
+            if self._obs is not None:
+                self._h_snap_blocked.observe(blocked)
         self._snap_thread = None
 
     def _checkpoint_async(self, force: bool) -> None:
@@ -1028,8 +1065,10 @@ class FusedPipeline:
                 # replay safe); the hot loop keeps running.
                 logger.exception("Background snapshot failed")
             finally:
-                self.metrics.snapshot_stalls.append(
-                    time.perf_counter() - t0)
+                stall = time.perf_counter() - t0
+                self.metrics.snapshot_stalls.append(stall)
+                if self._obs is not None:
+                    self._h_snap_write.observe(stall)
 
         self._snap_thread = threading.Thread(
             target=write, name="snapshot-writer", daemon=True)
@@ -1156,7 +1195,12 @@ class FusedPipeline:
                         # deque depth oscillates under the tunnel's
                         # bursty completion and washes out.
                         self._drain_waited = True
-                    jax.block_until_ready(valid)
+                    if self._obs is None:
+                        jax.block_until_ready(valid)
+                    else:
+                        t_w = time.perf_counter()
+                        jax.block_until_ready(valid)
+                        self._h_device.observe(time.perf_counter() - t_w)
                     if block > 0:
                         block -= 1
             self.consumer.acknowledge(msg)
@@ -1166,8 +1210,15 @@ class FusedPipeline:
             idle_timeout_s: float = 1.0) -> None:
         t_start = time.perf_counter()
         idle_since = time.monotonic()
-        with maybe_trace(self.config.profile_dir):
-            self._run_loop(max_events, idle_timeout_s, idle_since)
+        try:
+            with maybe_trace(self.config.profile_dir):
+                self._run_loop(max_events, idle_timeout_s, idle_since)
+        except Exception:
+            # The crash forensics surface: the ring holds the last N
+            # per-batch records leading up to this exception.
+            if self._obs is not None:
+                self._obs.dump_flight("run-loop-exception")
+            raise
         if self.checkpointing:
             if self._inflight:
                 self._checkpoint_and_ack()  # flushes the writer first
@@ -1196,7 +1247,12 @@ class FusedPipeline:
                   idle_timeout_s: float, idle_since: float) -> None:
         while True:
             try:
-                msg = self.consumer.receive(timeout_millis=50)
+                if self._obs is None:
+                    msg = self.consumer.receive(timeout_millis=50)
+                else:
+                    t_rx = time.perf_counter()
+                    msg = self.consumer.receive(timeout_millis=50)
+                    self._h_dequeue.observe(time.perf_counter() - t_rx)
             except ReceiveTimeout:
                 if self.checkpointing and self._inflight:
                     self._checkpoint_and_ack()

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from attendance_tpu import obs
 from attendance_tpu.config import Config
 from attendance_tpu.pipeline.events import AttendanceEvent, decode_event
 from attendance_tpu.sketch import make_sketch_store
@@ -69,6 +70,10 @@ class ProcessorMetrics:
 
     @property
     def events_per_second(self) -> float:
+        """0.0 when no wall clock was measured — callers that format
+        rates use this; consumers that must distinguish "instant run"
+        from "dead run" read to_dict/summary, which report null/"n/a"
+        instead (a 0.0 there reads as a dead pipeline)."""
         return self.events / self.wall_seconds if self.wall_seconds else 0.0
 
     def to_dict(self, estimated_fpr: Optional[float] = None,
@@ -79,7 +84,11 @@ class ProcessorMetrics:
         return {
             "events": self.events,
             "batches": self.batches,
-            "events_per_second": round(self.events_per_second, 1),
+            # null, not 0.0, when no wall clock was measured: a zero
+            # rate means "dead run" to downstream consumers, which an
+            # instant (or never-timed) run is not.
+            "events_per_second": round(self.events_per_second, 1)
+            if self.wall_seconds else None,
             "mean_batch": round(sum(self.batch_sizes)
                                 / len(self.batch_sizes), 1)
             if self.batch_sizes else 0.0,
@@ -129,8 +138,10 @@ class ProcessorMetrics:
                     else "validity in store (async)")
         wires = ("" if not self.wire_dwell else "; wires " + ",".join(
             f"{k}:{v}" for k, v in sorted(self.wire_dwell.items())))
+        rate = (f"{self.events_per_second:.0f}"
+                if self.wall_seconds else "n/a")
         return (f"{self.events} events in {self.batches} batches "
-                f"({self.events_per_second:.0f} ev/s; mean batch "
+                f"({rate} ev/s; mean batch "
                 f"{mean_batch:.0f}; device {self.device_seconds:.3f}s; "
                 f"est. bloom FPR {fpr}; {validity}, "
                 f"{self.nacked_batches} nacked, {self.dead_lettered} "
@@ -150,6 +161,13 @@ class AttendanceProcessor:
     def __init__(self, config: Optional[Config] = None, *,
                  client=None, sketch_store=None, event_store=None):
         self.config = config or Config()
+        # Live telemetry (obs/), created before the transport so broker
+        # queues register depth gauges; one branch per hook when off.
+        self._obs = obs.ensure(self.config)
+        if self._obs is not None:
+            self._h_assembly = self._obs.stage("batch_assembly")
+            self._h_sketch = self._obs.stage("sketch")
+            self._h_persist = self._obs.stage("persist")
         self.client = client or make_client(self.config)
         self.consumer = self.client.subscribe(
             self.config.pulsar_topic, self.SUBSCRIPTION)
@@ -273,7 +291,8 @@ class AttendanceProcessor:
         with maybe_annotate(self._profiling, "bf_exists_batch"):
             is_valid = np.asarray(self.sketch.bf_exists_many(
                 self.config.bloom_filter_key, student_ids))
-        self.metrics.device_seconds += time.perf_counter() - t0
+        d_bf = time.perf_counter() - t0
+        self.metrics.device_seconds += d_bf
 
         # 2. Persist every event with computed validity (reference
         #    attendance_processor.py:116-124 stores valid and invalid alike).
@@ -283,7 +302,10 @@ class AttendanceProcessor:
                               is_valid=bool(v),
                               event_type=e.event_type)
                 for e, v in zip(events, is_valid)]
+        t_persist = time.perf_counter()
         self.store.insert_batch(rows)
+        if self._obs is not None:
+            self._h_persist.observe(time.perf_counter() - t_persist)
 
         # 3. Valid events only -> HLL, one PFADD per distinct lecture key
         #    (reference attendance_processor.py:127-129).
@@ -297,7 +319,10 @@ class AttendanceProcessor:
                 self.sketch.pfadd_many(
                     f"{self.config.hll_key_prefix}{lecture_id}",
                     np.array(members, dtype=np.int64))
-        self.metrics.device_seconds += time.perf_counter() - t1
+        d_pf = time.perf_counter() - t1
+        self.metrics.device_seconds += d_pf
+        if self._obs is not None:
+            self._h_sketch.observe(d_bf + d_pf)
 
         # 4. Optional invalid routing (README-promised DLQ topic): each
         #    computed-invalid event republished on the side topic, in
@@ -320,6 +345,13 @@ class AttendanceProcessor:
         self.metrics.valid_events += nv
         self.metrics.invalid_events += len(events) - nv
         self.metrics.batch_sizes.append(len(events))
+        if self._obs is not None:
+            self._obs.events.inc(len(events))
+            self._obs.frames.inc()
+            self._obs.record_batch(
+                ts=round(time.time(), 6), events=len(events), valid=nv,
+                invalid=len(events) - nv,
+                sketch_s=round(d_bf + d_pf, 6))
         return is_valid
 
     # -- streaming loop -----------------------------------------------------
@@ -336,7 +368,12 @@ class AttendanceProcessor:
                       checkpoint_and_ack, pending_acks) -> None:
         consecutive_failures = 0
         while True:
-            msgs = self._collect_batch()
+            if self._obs is None:
+                msgs = self._collect_batch()
+            else:
+                t_asm = time.perf_counter()
+                msgs = self._collect_batch()
+                self._h_assembly.observe(time.perf_counter() - t_asm)
             if not msgs:
                 if pending_acks:
                     checkpoint_and_ack()
@@ -418,6 +455,11 @@ class AttendanceProcessor:
                                    checkpoint_and_ack, pending_acks)
         except KeyboardInterrupt:
             logger.info("Stopping attendance processing...")
+        except Exception:
+            # Crash forensics: dump the per-batch ring before unwinding.
+            if self._obs is not None:
+                self._obs.dump_flight("run-loop-exception")
+            raise
         finally:
             if pending_acks:
                 checkpoint_and_ack()

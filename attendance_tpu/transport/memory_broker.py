@@ -54,8 +54,39 @@ class _Subscription:
     requeues ITS unacked messages, not those delivered to still-live
     competing consumers (Pulsar crash-takeover semantics)."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, topic: str = ""):
         self.name = name
+        # Live telemetry hooks (obs/): resolved ONCE here — when the
+        # process has no telemetry every hot-path hook below is a
+        # single `is not None` branch. The queue-depth gauge is a
+        # CALLBACK read at scrape time, so depth tracking costs the
+        # enqueue/pop paths nothing at all.
+        from attendance_tpu import obs
+        t = obs.get()
+        if t is not None:
+            import weakref
+            labels = dict(topic=topic, subscription=name)
+            ref = weakref.ref(self)  # a dead sub must not be pinned
+            t.registry.gauge(
+                "attendance_queue_depth",
+                help="Pending messages on a broker subscription",
+                **labels).set_function(
+                    lambda ref=ref: s._count
+                    if (s := ref()) is not None else 0)
+            self._obs_redelivered = t.registry.counter(
+                "attendance_broker_redeliveries_total",
+                help="Messages requeued by nack or consumer crash",
+                **labels)
+            self._obs_recv_msgs = t.registry.counter(
+                "attendance_broker_received_messages_total",
+                help="Messages delivered to consumers", **labels)
+            self._obs_recv_bytes = t.registry.counter(
+                "attendance_broker_received_bytes_total",
+                help="Payload bytes delivered to consumers", **labels)
+        else:
+            self._obs_redelivered = None
+            self._obs_recv_msgs = None
+            self._obs_recv_bytes = None
         # Pending messages, block-structured: sealed blocks are
         # [entries_list, consumed_offset] pairs; _tail is the open
         # block single-message enqueues append to (sealed lazily).
@@ -198,6 +229,10 @@ class _Subscription:
             popped = self._pop_entries(max_n)
             if register is not None:
                 register(popped)
+            if self._obs_recv_msgs is not None:
+                self._obs_recv_msgs.inc(len(popped))
+                self._obs_recv_bytes.inc(
+                    sum(len(data) for _, data, _ in popped))
             return popped
 
     def receive_chunk(self, max_n: int, timeout_s: Optional[float],
@@ -233,6 +268,8 @@ class _Subscription:
                             for mid, data, red in entry[0]]
                 self._append_block(requeued)
                 self._notify_if_waiting(len(requeued))
+                if self._obs_redelivered is not None:
+                    self._obs_redelivered.inc(len(requeued))
 
     def explode_chunk(self, chunk_id: int) -> None:
         """Convert a chunk's messages into ordinary per-message
@@ -268,6 +305,8 @@ class _Subscription:
                 data, redeliveries, _ = entry
                 self._append_one((message_id, data, redeliveries + 1))
                 self._notify_if_waiting()
+                if self._obs_redelivered is not None:
+                    self._obs_redelivered.inc()
 
     def requeue_inflight(self, owner: int) -> None:
         """Crash takeover: return the closing consumer's own unacked
@@ -281,12 +320,16 @@ class _Subscription:
                 self._append_one((mid, data, redeliveries + 1))
             my_chunks = [cid for cid, (_, o) in self.chunk_inflight.items()
                          if o == owner]
+            chunk_msgs = 0
             for cid in my_chunks:
                 popped, _ = self.chunk_inflight.pop(cid)
+                chunk_msgs += len(popped)
                 self._append_block(
                     [(mid, data, red + 1) for mid, data, red in popped])
             if mine or my_chunks:
                 self.cond.notify_all()
+                if self._obs_redelivered is not None:
+                    self._obs_redelivered.inc(len(mine) + chunk_msgs)
 
     def backlog(self) -> int:
         with self.cond:
@@ -307,7 +350,8 @@ class _Topic:
         with self.lock:
             sub = self.subscriptions.get(name)
             if sub is None:
-                sub = self.subscriptions[name] = _Subscription(name)
+                sub = self.subscriptions[name] = _Subscription(
+                    name, topic=self.name)
                 # A new subscription starts at the earliest retained
                 # message (the generator may run before the processor).
                 sub.enqueue_many([(mid, data, 0)
@@ -377,10 +421,25 @@ class MemoryProducer:
     def __init__(self, topic: _Topic):
         self._topic = topic
         self._closed = False
+        from attendance_tpu import obs
+        t = obs.get()
+        if t is not None:
+            self._obs_msgs = t.registry.counter(
+                "attendance_broker_sent_messages_total",
+                help="Messages published", topic=topic.name)
+            self._obs_bytes = t.registry.counter(
+                "attendance_broker_sent_bytes_total",
+                help="Payload bytes published", topic=topic.name)
+        else:
+            self._obs_msgs = None
+            self._obs_bytes = None
 
     def send(self, data: bytes) -> int:
         if self._closed:
             raise RuntimeError("producer closed")
+        if self._obs_msgs is not None:
+            self._obs_msgs.inc()
+            self._obs_bytes.inc(len(data))
         return self._topic.publish(bytes(data))
 
     def send_many(self, datas) -> int:
@@ -388,6 +447,10 @@ class MemoryProducer:
         one broker pass for the whole batch. Returns the first id."""
         if self._closed:
             raise RuntimeError("producer closed")
+        if self._obs_msgs is not None:
+            datas = [bytes(d) for d in datas]
+            self._obs_msgs.inc(len(datas))
+            self._obs_bytes.inc(sum(len(d) for d in datas))
         return self._topic.publish_many(datas)
 
     def flush(self) -> None:

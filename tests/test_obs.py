@@ -1,0 +1,329 @@
+"""Live telemetry subsystem tests (attendance_tpu/obs).
+
+Covers the registry semantics (counter monotonicity, histogram
+power-of-2 bucket boundaries, gauge set/add/callback), the Prometheus
+text exposition (golden file + format validity), the flight-recorder
+ring (wrap order, SIGUSR1 dump, run-loop crash dump), the HTTP scrape
+of a live fused run (the acceptance scenario), and the disabled-path
+contract (no telemetry object anywhere when the flags are unset).
+"""
+
+import json
+import os
+import re
+import signal
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from attendance_tpu import obs
+from attendance_tpu.config import Config
+from attendance_tpu.obs.exposition import (
+    format_file, parse_prom, render)
+from attendance_tpu.obs.recorder import FlightRecorder
+from attendance_tpu.obs.registry import NUM_BUCKETS, Registry
+
+GOLDEN = Path(__file__).parent / "data" / "obs_exposition.golden"
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Telemetry is process-global; every test starts and ends bare."""
+    obs.disable()
+    yield
+    obs.disable()
+
+
+# -- registry semantics ------------------------------------------------------
+
+def test_counter_monotonic():
+    reg = Registry()
+    c = reg.counter("c_total")
+    c.inc()
+    c.inc(41)
+    assert c.value == 42
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert c.value == 42  # the failed inc changed nothing
+
+
+def test_gauge_set_add_and_callback():
+    reg = Registry()
+    g = reg.gauge("g")
+    g.set(10)
+    g.add(-3)
+    assert g.value == 7
+    g.set_function(lambda: 99)
+    assert g.value == 99
+    g.set(1)  # set clears the callback
+    assert g.value == 1
+
+
+def test_histogram_bucket_boundaries():
+    """Power-of-2 buckets: scaled value u lands in bucket
+    u.bit_length(), whose upper bound is 2**i / scale — observed at
+    the exact boundaries."""
+    reg = Registry()
+    h = reg.histogram("h", scale=1.0)
+    for v in (0, 0.5, 1, 2, 3, 4, 7, 8):
+        h.observe(v)
+    buckets, total, count = h.snapshot()
+    assert count == 8 and total == 25.5
+    assert buckets[0] == 2          # 0, 0.5  -> u=0, below 2^0
+    assert buckets[1] == 1          # 1       -> [1, 2)
+    assert buckets[2] == 2          # 2, 3    -> [2, 4)
+    assert buckets[3] == 2          # 4, 7    -> [4, 8)
+    assert buckets[4] == 1          # 8       -> [8, 16)
+    assert h.bucket_bound(0) == 1.0 and h.bucket_bound(4) == 16.0
+    # Over-range samples count toward +Inf (sum/count) ONLY — never a
+    # finite bucket, which would claim the sample was below its bound.
+    h.observe(2.0 ** 60)
+    buckets, total, count = h.snapshot()
+    assert count == 9 and sum(buckets) == 8
+    assert buckets[NUM_BUCKETS - 1] == 0
+    reg2 = Registry()
+    h2 = reg2.histogram("of", scale=1.0)
+    h2.observe(2.0 ** 60)
+    lines = render(reg2).splitlines()
+    finite = [l for l in lines if "_bucket" in l and "+Inf" not in l]
+    assert all(l.endswith(" 0") for l in finite)
+    assert [l for l in lines if "+Inf" in l][0].endswith(" 1")
+
+
+def test_registry_identity_and_kind_mismatch():
+    reg = Registry()
+    a = reg.counter("x_total", wire="word")
+    b = reg.counter("x_total", wire="word")
+    assert a is b  # re-requesting a handle never double-registers
+    assert reg.counter("x_total", wire="seg") is not a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")  # same name, different kind
+
+
+# -- exposition --------------------------------------------------------------
+
+def _golden_registry() -> Registry:
+    reg = Registry()
+    c = reg.counter("attendance_events_total", help="Events processed")
+    c.inc(41)
+    c.inc()
+    reg.counter("attendance_wire_frames_total", help="Frames per wire",
+                wire="word").inc(3)
+    reg.counter("attendance_wire_frames_total", wire="seg").inc(2)
+    g = reg.gauge("attendance_queue_depth", help="Pending messages",
+                  topic="t", subscription="s")
+    g.set(7)
+    h = reg.histogram("attendance_stage_latency_seconds",
+                      help="Per-stage latency", stage="decode")
+    h.observe(3e-6)
+    h.observe(0.001)
+    h.observe(0.5)
+    return reg
+
+
+def test_exposition_matches_golden_file():
+    assert render(_golden_registry()) == GOLDEN.read_text()
+
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_+][a-zA-Z0-9_]*="[^"]*")*\})? '
+    r'(-?\d+(\.\d+)?([eE][-+]?\d+)?|\+Inf|-Inf|NaN)$')
+
+
+def test_exposition_is_valid_prometheus_text():
+    """Every non-comment line is a well-formed sample; histograms are
+    cumulative and consistent with _count."""
+    text = render(_golden_registry())
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert _SAMPLE_RE.match(line), f"bad exposition line: {line!r}"
+    # Cumulative buckets never decrease; +Inf bucket == _count.
+    samples = parse_prom(text)
+    hist = [(labels, float(v)) for name, labels, v in samples
+            if name == "attendance_stage_latency_seconds_bucket"]
+    values = [v for _, v in hist]
+    assert values == sorted(values)
+    count = [float(v) for name, labels, v in samples
+             if name == "attendance_stage_latency_seconds_count"][0]
+    assert values[-1] == count == 3
+
+
+def test_prom_table_formatter(tmp_path):
+    path = tmp_path / "m.prom"
+    path.write_text("# scrape 1.0\n" + render(_golden_registry()))
+    table = format_file(str(path))
+    assert "attendance_events_total" in table
+    assert "count=3" in table  # histogram folded to count/sum/mean
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_flight_ring_wraps_in_order():
+    fr = FlightRecorder(4)
+    for i in range(10):
+        fr.record({"i": i})
+    assert fr.total == 10
+    assert [r["i"] for r in fr.snapshot()] == [6, 7, 8, 9]
+
+
+def test_sigusr1_dump_is_wellformed_json(tmp_path):
+    dump = tmp_path / "flight.json"
+    t = obs.enable(Config(flight_recorder=8, flight_path=str(dump)))
+    for i in range(3):
+        t.record_batch(ts=float(i), events=i)
+    os.kill(os.getpid(), signal.SIGUSR1)
+    deadline = time.monotonic() + 5.0
+    while not dump.exists() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    doc = json.loads(dump.read_text())
+    assert doc["reason"] == "SIGUSR1"
+    assert doc["total_records"] == 3
+    assert [r["events"] for r in doc["records"]] == [0, 1, 2]
+
+
+def test_disable_restores_displaced_sigusr1_handler(tmp_path):
+    """A leaked handler would dump a stale ring to a stale path after
+    telemetry is torn down — disable() must restore what it displaced."""
+    before = signal.getsignal(signal.SIGUSR1)
+    obs.enable(Config(flight_recorder=4,
+                      flight_path=str(tmp_path / "f.json")))
+    assert signal.getsignal(signal.SIGUSR1) is not before
+    obs.disable()
+    assert signal.getsignal(signal.SIGUSR1) == before
+
+
+def test_run_loop_crash_dumps_flight_ring(tmp_path):
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    dump = tmp_path / "crash.json"
+    config = Config(bloom_filter_capacity=2_000, flight_recorder=16,
+                    flight_path=str(dump))
+    obs.enable(config)
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    roster, frames = generate_frames(1_024, 512, roster_size=1_000,
+                                     num_lectures=2)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+
+    def boom(block=0):
+        raise RuntimeError("synthetic ack-path failure")
+
+    pipe._drain_inflight = boom
+    with pytest.raises(RuntimeError, match="synthetic"):
+        pipe.run(max_events=1_024, idle_timeout_s=0.2)
+    doc = json.loads(dump.read_text())
+    assert doc["reason"] == "run-loop-exception"
+    assert doc["records"], "crash dump carried no per-batch records"
+    assert doc["records"][-1]["events"] == 512
+
+
+# -- the acceptance scenario: scrape a live fused run ------------------------
+
+def test_http_scrape_of_fused_run_exposes_contract_metrics(tmp_path):
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(bloom_filter_capacity=5_000, metrics_port=-1,
+                    flight_recorder=16,
+                    flight_path=str(tmp_path / "flight.json"))
+    t = obs.enable(config)
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    roster, frames = generate_frames(4_096, 1_024, roster_size=4_000,
+                                     num_lectures=4)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=4_096, idle_timeout_s=0.3)
+
+    assert t.http_port
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{t.http_port}/metrics", timeout=10) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+
+    samples = {(n, l): float(v) for n, l, v in parse_prom(text)}
+    # The scrape contract from the issue: events counter, per-wire
+    # dispatch counter, queue-depth gauge, stage-latency histogram
+    # with populated buckets.
+    assert samples[("attendance_events_total", "")] == 4_096
+    wire_total = sum(v for (n, l), v in samples.items()
+                     if n == "attendance_wire_frames_total")
+    assert wire_total == 4  # one per frame
+    assert any(n == "attendance_queue_depth" and "subscription=" in l
+               for (n, l), _ in samples.items())
+    dispatch_count = [v for (n, l), v in samples.items()
+                      if n == "attendance_stage_latency_seconds_count"
+                      and 'stage="dispatch"' in l]
+    assert dispatch_count and dispatch_count[0] == 4
+    populated = [v for (n, l), v in samples.items()
+                 if n == "attendance_stage_latency_seconds_bucket"
+                 and 'stage="dispatch"' in l]
+    assert max(populated) == 4  # cumulative buckets reach the count
+    # Broker counters rode along.
+    assert samples[("attendance_broker_received_messages_total",
+                    f'subscription="{pipe.SUBSCRIPTION}",'
+                    f'topic="{config.pulsar_topic}"')] >= 4
+
+
+def test_file_reporter_appends_scrape_blocks(tmp_path):
+    path = tmp_path / "metrics.prom"
+    t = obs.enable(Config(metrics_prom=str(path),
+                          metrics_interval_s=0.05))
+    t.events.inc(7)
+    time.sleep(0.2)
+    obs.disable()  # stop() writes one final block
+    text = path.read_text()
+    assert text.count("# scrape ") >= 2
+    samples = {n: v for n, l, v in parse_prom(text)}
+    assert float(samples["attendance_events_total"]) == 7
+
+
+def test_disabled_flags_leave_hot_paths_bare():
+    """With every telemetry flag unset nothing is created anywhere:
+    the pipelines hold None and pay one branch per hook."""
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.processor import AttendanceProcessor
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(bloom_filter_capacity=1_000)
+    pipe = FusedPipeline(config, client=MemoryClient(MemoryBroker()),
+                         num_banks=8)
+    proc = AttendanceProcessor(
+        Config(sketch_backend="memory"),
+        client=MemoryClient(MemoryBroker()))
+    assert obs.get() is None
+    assert pipe._obs is None and proc._obs is None
+
+
+def test_cli_telemetry_verb_formats_both_artifacts(tmp_path, capsys):
+    from attendance_tpu.cli import main
+
+    fr = FlightRecorder(4)
+    fr.record({"ts": 1.0, "events": 512, "wire": "word"})
+    dump = fr.dump(tmp_path / "flight.json")
+    main(["telemetry", str(dump)])
+    out = capsys.readouterr().out
+    assert "flight recorder dump" in out and "word" in out
+
+    prom = tmp_path / "m.prom"
+    prom.write_text(render(_golden_registry()))
+    main(["telemetry", str(prom)])
+    out = capsys.readouterr().out
+    assert "attendance_events_total" in out and "42" in out

@@ -75,6 +75,21 @@ def test_metrics_summary_handles_missing_fpr():
     assert "est. bloom FPR n/a" in m.summary(None)
 
 
+def test_zero_wall_clock_reports_null_rate_not_zero():
+    """wall_seconds == 0 means "no wall clock was measured", not "dead
+    pipeline": to_dict must emit null and summary must print n/a so
+    downstream consumers cannot mistake an instant run for a stall."""
+    m = ProcessorMetrics()
+    m.events, m.batches = 10, 1
+    assert m.wall_seconds == 0.0
+    assert m.to_dict()["events_per_second"] is None
+    assert "n/a ev/s" in m.summary(None)
+    # A measured clock restores the numeric rate in both surfaces.
+    m.wall_seconds = 2.0
+    assert m.to_dict()["events_per_second"] == 5.0
+    assert "5 ev/s" in m.summary(None)
+
+
 def test_profile_flag_writes_trace_artifact(tmp_path):
     from attendance_tpu.pipeline.processor import AttendanceProcessor
     from attendance_tpu.pipeline.generator import generate_student_data
