@@ -311,10 +311,11 @@ def cmd_pipeline(args) -> None:
 
 
 def cmd_telemetry(args) -> None:
-    """Pretty-print a telemetry artifact as a live-style table: a
-    flight-recorder JSON dump (``kill -USR1`` / crash / --flight-path)
-    or a Prometheus exposition file (--metrics-prom; the last scrape
-    block is shown). The format is sniffed from the file content."""
+    """Pretty-print a telemetry artifact: a flight-recorder JSON dump
+    (``kill -USR1`` / crash / --flight-path), a Prometheus exposition
+    file (--metrics-prom; the last scrape block is shown), or a
+    Chrome-trace export (--trace-out; per-trace span trees with
+    durations). The format is sniffed from the file content."""
     import sys
 
     from attendance_tpu.obs.exposition import format_file
@@ -429,11 +430,13 @@ def main(argv=None) -> None:
     p_br.set_defaults(fn=cmd_bridge)
 
     p_tel = sub.add_parser(
-        "telemetry", help="pretty-print a flight-recorder dump or a "
-        "--metrics-prom exposition file as a live-style table")
-    p_tel.add_argument("path", help="flight dump JSON or prom text file")
+        "telemetry", help="pretty-print a flight-recorder dump, a "
+        "--metrics-prom exposition file, or a --trace-out span trace "
+        "as a live-style table / span tree")
+    p_tel.add_argument("path", help="flight dump JSON, prom text, or "
+                       "Chrome-trace JSON file")
     p_tel.add_argument("--last", type=int, default=32,
-                       help="flight records shown (most recent)")
+                       help="flight records / traces shown (most recent)")
     p_tel.set_defaults(fn=cmd_telemetry)
 
     p_par = sub.add_parser(

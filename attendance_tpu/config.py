@@ -121,6 +121,13 @@ class Config:
     metrics_interval_s: float = 1.0
     flight_recorder: int = 0
     flight_path: str = "flight_recorder.json"
+    # Span tracing ("" = disabled): collect per-batch spans (publish ->
+    # dequeue -> decode -> dispatch -> device_wait, trace context
+    # propagated through broker message properties) into a bounded
+    # in-memory buffer, flushed to this path as Chrome-trace/Perfetto
+    # JSON at end of run / teardown. Same disabled-path guarantee as
+    # the metrics flags: unset = one branch per hook.
+    trace_out: str = ""
     # Wire format for the fused pipeline's host->device transfer.
     # Either the link or the host-side pack is the e2e bottleneck,
     # depending on the moment's link rate vs host load; "auto" starts
@@ -256,6 +263,9 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    "(0 = off); dumped on SIGUSR1 or run-loop crash")
     p.add_argument("--flight-path", default=d.flight_path,
                    help="JSON dump path for the flight recorder")
+    p.add_argument("--trace-out", default=d.trace_out,
+                   help="write per-batch spans as Chrome-trace/"
+                   "Perfetto JSON here (empty = tracing off)")
     return p
 
 
@@ -294,4 +304,5 @@ def config_from_args(args: argparse.Namespace) -> Config:
         metrics_interval_s=args.metrics_interval_s,
         flight_recorder=args.flight_recorder,
         flight_path=args.flight_path,
+        trace_out=args.trace_out,
     ).validate()

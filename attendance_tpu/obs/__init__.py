@@ -28,6 +28,19 @@ Metric names (the stable scrape contract, asserted by tests):
   counters (messages, bytes, redeliveries).
 * ``attendance_shard_events{replica=...}`` — per-replica event totals
   of the sharded engine, aggregated at report time.
+* Sketch health (callback gauges, device reads ONLY at scrape time —
+  see obs/health.py): ``attendance_bloom_fill_fraction`` and
+  ``attendance_bloom_estimated_fpr`` (occupancy-based fill^k, the
+  paper's <=1% FPR target made live), ``attendance_hll_estimate``
+  (summed Ertl estimate over registered banks) and
+  ``attendance_hll_saturated_registers`` (registers at rank > q —
+  the saturation the <=2% relative-error target degrades under).
+
+Span tracing (obs/tracing.py, ``--trace-out``) rides the same bundle:
+one Tracer on the Telemetry object, same capture-once/one-branch
+discipline, flushed as Chrome-trace/Perfetto JSON at end of run and on
+teardown; trace context propagates through broker message properties
+(``traceparent``).
 """
 
 from __future__ import annotations
@@ -41,6 +54,8 @@ from attendance_tpu.obs.recorder import (  # noqa: F401
     uninstall_sigusr1)
 from attendance_tpu.obs.registry import (  # noqa: F401
     Counter, Gauge, Histogram, Registry)
+from attendance_tpu.obs.tracing import (  # noqa: F401
+    TRACEPARENT, SpanContext, Tracer, format_ctx, parse_ctx)
 
 logger = logging.getLogger(__name__)
 
@@ -51,12 +66,29 @@ _lock = threading.Lock()
 
 DEFAULT_FLIGHT_PATH = "flight_recorder.json"
 
+_atexit_installed = False
+
+
+def _install_atexit_flush() -> None:
+    """Register the exit-time trace flush exactly once per process; it
+    reads the CURRENT global, so stopped instances are neither pinned
+    nor flushed."""
+    global _atexit_installed
+    if _atexit_installed:
+        return
+    _atexit_installed = True
+    import atexit
+
+    atexit.register(
+        lambda: TELEMETRY is not None and TELEMETRY.flush_trace("atexit"))
+
 
 def enabled_in(config) -> bool:
     """Does this config ask for live telemetry at all?"""
     return bool(getattr(config, "metrics_prom", "")
                 or getattr(config, "metrics_port", 0)
-                or getattr(config, "flight_recorder", 0))
+                or getattr(config, "flight_recorder", 0)
+                or getattr(config, "trace_out", ""))
 
 
 class Telemetry:
@@ -65,12 +97,19 @@ class Telemetry:
     def __init__(self, *, metrics_prom: str = "", metrics_port: int = 0,
                  metrics_interval_s: float = 1.0,
                  flight_recorder: int = 0,
-                 flight_path: str = DEFAULT_FLIGHT_PATH):
+                 flight_path: str = DEFAULT_FLIGHT_PATH,
+                 trace_out: str = ""):
         self.registry = Registry()
         self.flight: Optional[FlightRecorder] = (
             FlightRecorder(flight_recorder) if flight_recorder > 0
             else None)
         self.flight_path = flight_path or DEFAULT_FLIGHT_PATH
+        # Span tracer (obs/tracing.py): instrumented sites capture
+        # `telemetry.tracer` once and branch on `is not None` — a
+        # metrics-only run (trace_out unset) pays nothing for tracing.
+        self.tracer: Optional[Tracer] = (Tracer() if trace_out
+                                         else None)
+        self.trace_path = trace_out
         self._reporter = None
         self._server = None
         self._prev_sigusr1 = _NOT_INSTALLED
@@ -100,9 +139,20 @@ class Telemetry:
         if self.flight is not None:
             self._prev_sigusr1 = install_sigusr1(self.flight,
                                                  self.flight_path)
+        if self.tracer is not None:
+            # Backstop for CLI runs that never reach a run-loop flush
+            # (KeyboardInterrupt etc.); flush_trace is idempotent.
+            # ONE module-level hook flushing whatever telemetry is
+            # live at exit — per-instance registrations would pin
+            # every stopped Telemetry (and its up-to-64k-span buffer)
+            # for the process lifetime and rewrite possibly-deleted
+            # trace paths (bound-method atexit.unregister does not
+            # reliably match, so this never registers per instance).
+            _install_atexit_flush()
         return self
 
     def stop(self) -> None:
+        self.flush_trace("telemetry-stop")
         if self._reporter is not None:
             self._reporter.stop()
             self._reporter = None
@@ -152,6 +202,23 @@ class Telemetry:
         except Exception:
             logger.exception("Flight recorder dump failed")
 
+    # -- tracing -------------------------------------------------------------
+    def flush_trace(self, reason: str = "flush") -> None:
+        """Write the span buffer to ``--trace-out`` (atomic; no-op
+        without a tracer). Called at the end of every run loop, on
+        stop(), and at process exit — a crash loses at most the spans
+        since the last completed run."""
+        if self.tracer is None or not self.trace_path:
+            return
+        if not len(self.tracer):
+            return  # nothing recorded (e.g. a sibling pipeline's exit)
+        try:
+            p = self.tracer.flush(self.trace_path)
+            logger.info("Trace (%d spans) written to %s (%s)",
+                        len(self.tracer), p, reason)
+        except Exception:
+            logger.exception("Trace flush failed")
+
     def render(self) -> str:
         from attendance_tpu.obs.exposition import render
         return render(self.registry)
@@ -169,7 +236,8 @@ def enable(config) -> Telemetry:
             metrics_interval_s=getattr(config, "metrics_interval_s", 1.0),
             flight_recorder=getattr(config, "flight_recorder", 0),
             flight_path=getattr(config, "flight_path",
-                                DEFAULT_FLIGHT_PATH))
+                                DEFAULT_FLIGHT_PATH),
+            trace_out=getattr(config, "trace_out", ""))
         t.start()
         TELEMETRY = t
         return t

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -35,12 +36,19 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 def _fmt(v) -> str:
     """Prometheus sample value: integers bare, floats via repr (both
-    are valid exposition floats; bare ints keep counters exact)."""
+    are valid exposition floats; bare ints keep counters exact).
+    Non-finite values render per the text-format spec (``NaN``,
+    ``+Inf``, ``-Inf``) — repr would emit ``nan``/``inf``, which
+    promtool rejects, and the int-folding below would raise on them."""
     if isinstance(v, bool):
         return str(int(v))
     if isinstance(v, int):
         return str(v)
     f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
     if f == int(f) and abs(f) < 1e15:
         return str(int(f))
     return repr(f)
@@ -69,7 +77,19 @@ def render(registry: Registry) -> str:
             if isinstance(m, Counter):
                 lines.append(f"{name}{_labels(m.labels)} {_fmt(m.value)}")
             elif isinstance(m, Gauge):
-                lines.append(f"{name}{_labels(m.labels)} {_fmt(m.value)}")
+                # Callback gauges (queue depths, sketch health) read
+                # live state at scrape time; one raising callback (a
+                # bad device read) must SKIP its sample with a warning
+                # — not 500 the /metrics endpoint, not abort the prom
+                # file append, and not render a lying 0.0.
+                try:
+                    v = m.read()
+                except Exception as exc:
+                    logger.warning(
+                        "gauge %s%s raised at scrape time; sample "
+                        "skipped: %r", name, _labels(m.labels), exc)
+                    continue
+                lines.append(f"{name}{_labels(m.labels)} {_fmt(v)}")
             elif isinstance(m, Histogram):
                 buckets, total, count = m.snapshot()
                 cum = 0
@@ -248,11 +268,73 @@ def format_flight_table(doc: dict, last: int = 32) -> str:
     return head + "\n" + _table(rows, cols or ["(empty)"])
 
 
+def format_trace_tree(doc: dict, last: int = 32) -> str:
+    """Chrome-trace export (--trace-out) -> per-trace span trees with
+    durations: one block per trace_id (most recent ``last`` traces),
+    spans indented under their parent in start order, each line
+    ``name  dur  [role]  {extra args}``."""
+    # Normalize up front: the trace-event format permits args-less
+    # events (foreign/profiler traces routed here by format_file's
+    # sniffing) and this formatter must print a tree, not KeyError.
+    events = [{**e, "args": e.get("args") or {}}
+              for e in doc.get("traceEvents", [])
+              if e.get("ph") == "X"]
+    roles = {e["pid"]: (e.get("args") or {}).get("name", "")
+             for e in doc.get("traceEvents", [])
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    by_trace: dict = {}
+    for e in events:
+        tid = e["args"].get("trace_id", "?")
+        by_trace.setdefault(tid, []).append(e)
+    # Most recent traces last, ordered by their earliest span.
+    ordered = sorted(by_trace.items(),
+                     key=lambda kv: min(e.get("ts", 0) for e in kv[1]))
+    shown = ordered[-last:]
+    out = [f"trace export: {len(events)} spans in {len(by_trace)} "
+           f"traces (showing last {len(shown)}); "
+           f"dropped={doc.get('otherData', {}).get('dropped_spans', 0)}"]
+
+    def _fmt_dur(us: float) -> str:
+        return (f"{us / 1e3:.3f}ms" if us < 1e6 else f"{us / 1e6:.3f}s")
+
+    for trace_id, spans in shown:
+        spans.sort(key=lambda e: (e.get("ts", 0), -e.get("dur", 0)))
+        children: dict = {}
+        ids = {e["args"].get("span_id") for e in spans}
+        roots = []
+        for e in spans:
+            parent = e["args"].get("parent_span_id")
+            if parent in ids and parent != e["args"].get("span_id"):
+                children.setdefault(parent, []).append(e)
+            else:
+                roots.append(e)
+        out.append(f"trace {trace_id}:")
+        stack = [(e, 1) for e in reversed(roots)]
+        while stack:
+            e, depth = stack.pop()
+            extra = {k: v for k, v in e["args"].items()
+                     if k not in ("trace_id", "span_id",
+                                  "parent_span_id")}
+            role = roles.get(e["pid"], "")
+            out.append("  " * depth + f"{e['name']}  "
+                       f"{_fmt_dur(e.get('dur', 0))}"
+                       + (f"  [{role}]" if role else "")
+                       + (f"  {extra}" if extra else ""))
+            for c in reversed(children.get(e["args"].get("span_id"),
+                                           [])):
+                stack.append((c, depth + 1))
+    return "\n".join(out)
+
+
 def format_file(path: str, last: int = 32) -> str:
-    """Sniff ``path`` (flight-dump JSON vs prom text) and format it."""
+    """Sniff ``path`` (trace export / flight-dump JSON / prom text)
+    and format it."""
     with open(path) as f:
         text = f.read()
     stripped = text.lstrip()
     if stripped.startswith("{"):
-        return format_flight_table(json.loads(text), last=last)
+        doc = json.loads(text)
+        if "traceEvents" in doc:
+            return format_trace_tree(doc, last=last)
+        return format_flight_table(doc, last=last)
     return format_prom_table(text)

@@ -1,0 +1,153 @@
+"""Sketch-health gauges: live accuracy telemetry at scrape time.
+
+The paper's accuracy targets (<=1% Bloom FPR, <=2% HLL relative error)
+are defined over sketch STATE, not traffic — so the scrape surface
+should report the live Bloom fill/FPR and HLL estimate/saturation, and
+accuracy regressions become visible DURING a run instead of only in
+post-hoc parity checks.
+
+Design constraints, in order:
+
+* Never on the hot path: every gauge is a CALLBACK registered lazily
+  at construction; the (potentially expensive) device reads —
+  popcount + D2H scalar for the filter, register histogram for the
+  HLL — run only when a scrape renders the registry. With telemetry
+  off nothing here is imported or registered at all.
+* Never pin the reporter: callbacks close over a ``weakref`` to the
+  pipeline/filter, reporting the registered default once it dies
+  (matching the queue-depth gauge discipline in memory_broker).
+* Never lie: a callback that RAISES (a dead device, a torn-down mesh)
+  propagates — the exposition layer skips the sample with a warning
+  (obs.exposition.render) rather than rendering a 0.0 that reads as
+  "FPR is zero".
+
+Metric names (part of the stable scrape contract in obs/__init__):
+
+* ``attendance_bloom_fill_fraction`` — fraction of set filter bits.
+* ``attendance_bloom_estimated_fpr`` — fill^k, the same estimator as
+  ``BloomFilter.estimated_fpr`` / ``FusedPipeline.estimated_fpr``
+  (including the packed-words variant), so the gauge and the model's
+  own method agree to float tolerance by construction.
+* ``attendance_hll_estimate`` — Ertl estimate summed over registered
+  banks (``models/hll.py:estimate_from_histogram``).
+* ``attendance_hll_saturated_registers`` — registers at rank > q
+  (the ``C[q+1]`` histogram bin): the saturation regime where the
+  relative-error target starts to degrade.
+"""
+
+from __future__ import annotations
+
+import weakref
+
+import numpy as np
+
+HEALTH_HELP = {
+    "attendance_bloom_fill_fraction":
+        "Fraction of set Bloom filter bits (scrape-time device read)",
+    "attendance_bloom_estimated_fpr":
+        "Occupancy-based Bloom FPR estimate (fill^k)",
+    "attendance_hll_estimate":
+        "HLL cardinality estimate summed over registered banks",
+    "attendance_hll_saturated_registers":
+        "HLL registers at rank > q (saturation)",
+}
+
+
+def _gauge(telemetry, name: str, fn, **labels) -> None:
+    telemetry.registry.gauge(
+        name, help=HEALTH_HELP[name], **labels).set_function(fn)
+
+
+def _deref(ref):
+    obj = ref()
+    if obj is None:
+        # Propagate: render() skips the sample with a warning; a dead
+        # pipeline has NO fill fraction, and 0.0 would claim an empty
+        # filter.
+        raise LookupError("sketch owner was torn down")
+    return obj
+
+
+def register_fused(telemetry, pipe, **labels) -> None:
+    """Register the four health gauges for a FusedPipeline (single-chip
+    packed-words state or the sharded engine). Called from the pipeline
+    constructor iff telemetry is live."""
+    import jax
+
+    if pipe.sharded and jax.process_count() > 1:
+        # Multi-controller lockstep: the fill/count reductions contain
+        # collectives, which must never run from a scrape thread on one
+        # process only — that would wedge the whole mesh.
+        return
+    ref = weakref.ref(pipe)
+
+    def fill() -> float:
+        p = _deref(ref)
+        if p.sharded:
+            return float(p.engine.fill_fraction())
+        from attendance_tpu.models.bloom import (
+            bloom_packed_fill_fraction)
+        return float(bloom_packed_fill_fraction(p.state.bloom_bits))
+
+    def fpr() -> float:
+        return fill() ** _deref(ref).params.k
+
+    def hll_estimate() -> float:
+        p = _deref(ref)
+        return float(sum(p.count_all().values()))
+
+    def hll_saturated() -> float:
+        p = _deref(ref)
+        q = 64 - p.config.hll_precision
+        if p.sharded:
+            # Max over the replica axis = the merged register view the
+            # query path counts with (register-max union).
+            regs = np.asarray(p.engine.regs).max(axis=0)
+        else:
+            regs = np.asarray(p.state.hll_regs)
+        return float((regs > q).sum())
+
+    _gauge(telemetry, "attendance_bloom_fill_fraction", fill, **labels)
+    _gauge(telemetry, "attendance_bloom_estimated_fpr", fpr, **labels)
+    _gauge(telemetry, "attendance_hll_estimate", hll_estimate, **labels)
+    _gauge(telemetry, "attendance_hll_saturated_registers",
+           hll_saturated, **labels)
+
+
+def register_bloom_filter(telemetry, bloom, **labels) -> None:
+    """Register fill/FPR gauges for a standalone
+    ``models.bloom.BloomFilter`` (the generic TpuSketchStore path);
+    label by filter key so multiple filters coexist."""
+    ref = weakref.ref(bloom)
+
+    def fill() -> float:
+        from attendance_tpu.models.bloom import bloom_fill_fraction
+        return float(bloom_fill_fraction(_deref(ref).bits))
+
+    def fpr() -> float:
+        return _deref(ref).estimated_fpr()
+
+    _gauge(telemetry, "attendance_bloom_fill_fraction", fill, **labels)
+    _gauge(telemetry, "attendance_bloom_estimated_fpr", fpr, **labels)
+
+
+def register_hll(telemetry, hll, **labels) -> None:
+    """Register estimate/saturation gauges for a standalone
+    ``models.hll.HyperLogLog``."""
+    ref = weakref.ref(hll)
+
+    def estimate() -> float:
+        from attendance_tpu.models.hll import (
+            best_histogram, estimate_from_histogram)
+        h = _deref(ref)
+        hists = np.asarray(best_histogram(h.regs, h.precision))
+        return float(sum(estimate_from_histogram(hists[b], h.precision)
+                         for b in h._bank_of.values()))
+
+    def saturated() -> float:
+        h = _deref(ref)
+        return float((np.asarray(h.regs) > 64 - h.precision).sum())
+
+    _gauge(telemetry, "attendance_hll_estimate", estimate, **labels)
+    _gauge(telemetry, "attendance_hll_saturated_registers", saturated,
+           **labels)

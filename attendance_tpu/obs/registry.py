@@ -94,14 +94,21 @@ class Gauge:
         with self._lock:
             self._fn = fn
 
-    @property
-    def value(self) -> float:
+    def read(self) -> float:
+        """Current value; a callback gauge's exception PROPAGATES —
+        the exposition layer skips the sample with a warning (a bad
+        device read must not render as a silent 0.0, which consumers
+        would read as "FPR is zero", the opposite of broken)."""
         with self._lock:
             fn = self._fn
             if fn is None:
                 return self._value
+        return float(fn())
+
+    @property
+    def value(self) -> float:
         try:
-            return float(fn())
+            return self.read()
         except Exception:
             # A dead callback (e.g. its subscription was torn down) must
             # not break every future scrape.

@@ -44,6 +44,7 @@ traffic stays local and only the 1-byte-per-key answer rides ICI.
 
 from __future__ import annotations
 
+import time
 import weakref
 from typing import Optional, Tuple
 
@@ -142,6 +143,10 @@ class ShardedSketchEngine:
         self.shard_events = np.zeros(self.dp, np.int64)
         from attendance_tpu import obs
         _t = obs.get()
+        # Span tracer (obs/tracing.py): replica-labeled dispatch spans
+        # nest under the pipeline's active batch span; one branch per
+        # step when tracing is off.
+        self._tracer = _t.tracer if _t is not None else None
         # Tracking is gated on telemetry being live at construction:
         # with the flags unset the step hooks below must stay one
         # branch (the documented disabled-path guarantee) — counters
@@ -591,9 +596,42 @@ class ShardedSketchEngine:
         step = self._word_step_cache.get(kw)
         if step is None:
             step = self._word_step_cache[kw] = self._make_step_words(kw)
+        t0 = time.perf_counter() if self._tracer is not None else 0.0
         valid, self.regs, self.counts = step(
             self.bits, self.regs, self.counts, jnp.asarray(words))
+        self._trace_dispatch("word", t0, n, len(words))
         return valid[:n]
+
+    def _trace_dispatch(self, wire: str, t0: float,
+                        n: Optional[int], padded: int) -> None:
+        """Replica-labeled dispatch spans: one span per dp slice that
+        carries real events this batch (the enqueue is async — the
+        span covers the host-side dispatch; device_wait is the
+        pipeline's own span). Nests under the batch span the fused
+        pipeline activated; a standalone engine call roots its own
+        trace. ``n`` is the real event count, or None when the engine
+        cannot know it (the narrow wires arrive pre-packed per
+        replica, fast_path.note_shard_events holds the split) — then
+        every replica gets a span with NO events arg rather than a
+        padded-count lie."""
+        tr = self._tracer
+        if tr is None:
+            return
+        t1 = time.perf_counter()
+        cur = tr.current()
+        trace_id = cur.trace_id if cur is not None else tr.new_id()
+        parent = cur.span_id if cur is not None else None
+        local = max(padded // self.dp, 1)
+        for r in range(self.dp):
+            args = {"replica": r, "wire": wire}
+            if n is not None:
+                c = n - r * local
+                if c <= 0:
+                    break
+                args["events"] = min(c, local)
+            tr.add_span("replica_dispatch", t0, t1, trace_id=trace_id,
+                        parent_id=parent, role="sharded-engine",
+                        args=args)
 
     def note_shard_events(self, lane_counts) -> None:
         """Attribute externally-packed per-replica event counts (the
@@ -620,8 +658,10 @@ class ShardedSketchEngine:
         if step is None:
             step = self._word_step_cache[key] = self._make_step_narrow(
                 mode, width, padded_local, self.num_banks)
+        t0 = time.perf_counter() if self._tracer is not None else 0.0
         valid, self.regs, self.counts = step(
             self.bits, self.regs, self.counts, bufs)
+        self._trace_dispatch(mode, t0, None, self.dp * padded_local)
         return valid
 
     def step(self, keys, bank_idx) -> jax.Array:
@@ -638,9 +678,11 @@ class ShardedSketchEngine:
         bbuf, _ = self._pad(bank_idx, -1, np.int32)
         mask = np.zeros(len(kbuf), dtype=bool)
         mask[:n] = True
+        t0 = time.perf_counter() if self._tracer is not None else 0.0
         valid, self.regs, self.counts = self._step(
             self.bits, self.regs, self.counts,
             jnp.asarray(kbuf), jnp.asarray(bbuf), jnp.asarray(mask))
+        self._trace_dispatch("arrays", t0, n, len(kbuf))
         return valid[:n]
 
     # -- device-side validity counters ---------------------------------------

@@ -28,6 +28,7 @@ from typing import Optional
 
 import numpy as np
 
+from attendance_tpu import obs
 from attendance_tpu.config import Config
 from attendance_tpu.pipeline.events import (
     columns_from_events, decode_event, decode_json_batch_columns,
@@ -50,6 +51,15 @@ class JsonBinaryBridge:
     def __init__(self, config: Optional[Config] = None, *,
                  client=None, out_topic: Optional[str] = None):
         self.config = config or Config()
+        # Live telemetry / span tracer (obs/): ensure-once BEFORE the
+        # transport (broker queues register depth gauges); one branch
+        # per batch when off. The bridge is a trace RELAY: each
+        # forwarded frame continues the trace of the first JSON
+        # message it folded in, so generator -> bridge -> fused
+        # pipeline reads as one tree.
+        self._obs = obs.ensure(self.config)
+        self._tracer = (self._obs.tracer if self._obs is not None
+                        else None)
         self.client = client or make_client(self.config)
         self.consumer = self.client.subscribe(
             self.config.pulsar_topic, self.SUBSCRIPTION)
@@ -70,15 +80,19 @@ class JsonBinaryBridge:
         """Convert one micro-batch and publish it.
 
         ``payloads`` are the raw JSON bytes; ``acks`` the matching ack
-        tokens — raw ``(message_id, data, redeliveries)`` tuples on the
-        memory broker's zero-wrapper/chunk lanes, Message objects
-        otherwise (see _drain). On the chunk lane ``chunks`` holds the
+        tokens — raw ``(message_id, data, redeliveries, properties)``
+        tuples on the memory broker's zero-wrapper/chunk lanes, Message
+        objects otherwise (see _drain). On the chunk lane ``chunks`` holds the
         (chunk_id, tuples) handles: the whole batch settles with one
         broker op per chunk, and the chunks are EXPLODED into
         per-message entries only on the poison path — which is off the
         steady-state budget by definition.
         """
         raw = self._raw or chunks is not None
+        span = out_props = None
+        if self._tracer is not None and acks:
+            span, out_props = self._begin_forward_span(acks[0], raw,
+                                                       len(payloads))
         try:
             cols = decode_json_batch_columns(payloads)
             good = acks
@@ -110,10 +124,13 @@ class JsonBinaryBridge:
                     handle_poison(msg, self.consumer, self.metrics,
                                   self.config, logger, count_nack=False)
             if not good:
+                if span is not None:  # whole batch dead-lettered
+                    self._tracer.end_span(span, error="all-poison")
                 return
             cols = {k: np.concatenate([p[k] for p in parts])
                     for k in parts[0]}
-        self.producer.send(encode_planar_batch(cols))
+        self.producer.send(encode_planar_batch(cols),
+                           properties=out_props)
         # Ack strictly after the binary frame is published: the bridge
         # never holds the only copy of an acknowledged event.
         if chunks is not None:
@@ -123,9 +140,32 @@ class JsonBinaryBridge:
             self.consumer.acknowledge_ids([t[0] for t in good])
         else:
             acknowledge_all(self.consumer, good)
+        if span is not None:
+            self._tracer.end_span(span, messages=len(good))
         self.metrics.batches += 1
         self.metrics.events += len(good)
         self.metrics.batch_sizes.append(len(good))
+
+    def _begin_forward_span(self, tok, raw: bool, n: int):
+        """Open the ``bridge_forward`` span continuing the first
+        token's trace and mint the outgoing frame's trace context: the
+        binary frame's properties parent under this span, so the fused
+        pipeline's batch span lands in the same tree as the JSON
+        publish that started it."""
+        from attendance_tpu.obs.tracing import (
+            TRACEPARENT, format_ctx, parse_ctx)
+
+        props = (tok[3] if raw else
+                 (tok.properties() if hasattr(tok, "properties")
+                  else None)) or {}
+        ctx = parse_ctx(props.get(TRACEPARENT))
+        span = self._tracer.start_span(
+            "bridge_forward",
+            trace_id=ctx.trace_id if ctx is not None else None,
+            parent_id=ctx.span_id if ctx is not None else None,
+            role="bridge", args={"messages": n})
+        return span, {TRACEPARENT: format_ctx(
+            span.context(ctx.seq if ctx is not None else 0))}
 
     def _drain(self):
         """One micro-batch as (payloads, ack_tokens, chunk_handles).
@@ -166,6 +206,8 @@ class JsonBinaryBridge:
                         self.metrics.summary(None, include_validity=False))
         if getattr(self.config, "metrics_json", ""):
             self.metrics.write_json_line(self.config.metrics_json)
+        if self._obs is not None:
+            self._obs.flush_trace("run-end")
 
     def cleanup(self) -> None:
         self.client.close()

@@ -70,7 +70,8 @@ from attendance_tpu.storage.columnar_store import ColumnarEventStore
 from attendance_tpu.transport import (
     acknowledge_all, handle_poison, make_client)
 from attendance_tpu.transport.memory_broker import ReceiveTimeout
-from attendance_tpu.utils.profiling import maybe_annotate, maybe_trace
+from attendance_tpu.utils.profiling import (
+    annotate_trace, maybe_annotate, maybe_trace)
 
 logger = logging.getLogger(__name__)
 
@@ -133,6 +134,10 @@ class FusedPipeline:
         # their depth gauges. With the flags unset every hook in this
         # class is one `is not None` branch (profiling.py discipline).
         self._obs = obs.ensure(self.config)
+        # Span tracer (obs/tracing.py): one more capture-once handle —
+        # a metrics-only run holds None here and pays one branch.
+        self._tracer = (self._obs.tracer if self._obs is not None
+                        else None)
         if self._obs is not None:
             self._h_dequeue = self._obs.stage("dequeue_wait")
             self._h_decode = self._obs.stage("decode")
@@ -262,8 +267,15 @@ class FusedPipeline:
         self._snap_copy = None
         if self._snap_dir is not None:
             self.restore()
+        if self._obs is not None:
+            # Sketch-health gauges: lazy callbacks — device reads
+            # (fill popcount, register histograms) happen only when a
+            # scrape renders the registry, never on the hot path.
+            from attendance_tpu.obs import health
+            health.register_fused(self._obs, self)
 
     _LUT_SIZE = 1 << 14  # covers ~44 years of calendar days from base
+    _TRACE_ROLE = "fused-pipeline"
 
     # -- roster -------------------------------------------------------------
     def preload(self, keys) -> None:
@@ -443,12 +455,34 @@ class FusedPipeline:
             self._h_dispatch.observe(t_end - t_dec)
             obs_t.events.inc(n)
             obs_t.frames.inc()
-            obs_t.record_batch(
+            trace_hex = ""
+            tr = self._tracer
+            if tr is not None:
+                # The batch span _run_loop activated; process_frame
+                # called directly (tests, embedding) roots fresh spans.
+                cur = tr.current()
+                tid = cur.trace_id if cur is not None else tr.new_id()
+                parent = cur.span_id if cur is not None else None
+                tr.add_span("decode", t0, t_dec, trace_id=tid,
+                            parent_id=parent, role=self._TRACE_ROLE,
+                            args={"events": n})
+                tr.add_span("dispatch", t_dec, t_end, trace_id=tid,
+                            parent_id=parent, role=self._TRACE_ROLE,
+                            args={"wire": self._last_wire})
+                trace_hex = f"{tid:016x}"
+            rec = dict(
                 ts=round(time.time(), 6), events=n,
                 wire=self._last_wire,
                 decode_s=round(t_dec - t0, 6),
                 dispatch_s=round(t_end - t_dec, 6),
                 inflight=len(self._inflight))
+            if trace_hex:
+                # Cross-reference: a flight-recorder dump names the
+                # trace each batch record belongs to, so wedged-run
+                # forensics can jump from the ring straight into the
+                # Perfetto span tree.
+                rec["trace"] = trace_hex
+            obs_t.record_batch(**rec)
         return valid_n
 
     def _word_step(self, kw: int):
@@ -731,7 +765,9 @@ class FusedPipeline:
         lanes = np.empty(n, np.int64)
         orig = np.empty(n, np.int64)
         pos = 0
+        tr = self._tracer
         for r, (ks, bs, ds) in enumerate(slices):
+            t_pack = time.perf_counter() if tr is not None else 0.0
             buf = perm = None
             if mode == "seg":
                 if nat is not None and len(ks):
@@ -751,6 +787,18 @@ class FusedPipeline:
                 if buf is None:
                     buf, perm = pack_delta(ks, bs, width, padded_local,
                                            num_banks, scan=scans[r])
+            if tr is not None:
+                # Replica-labeled host-pack spans: which dp slice's
+                # pack dominates the mesh batch (nests under the batch
+                # span via the tracer's active-span stack).
+                tr.add_span("pack", t_pack, time.perf_counter(),
+                            trace_id=(tr.current().trace_id
+                                      if tr.current() else tr.new_id()),
+                            parent_id=(tr.current().span_id
+                                       if tr.current() else None),
+                            role=self._TRACE_ROLE,
+                            args={"replica": r, "wire": mode,
+                                  "events": len(ks)})
             if bufs is None:
                 bufs = np.empty((dp, len(buf)), np.uint32)
             bufs[r] = buf
@@ -1044,7 +1092,7 @@ class FusedPipeline:
         bloom_host = self._bloom_host
         upto = (self.store.mark()
                 if hasattr(self.store, "mark") else None)
-        msgs = [m for m, _ in self._inflight]
+        msgs = [m for m, _, _ in self._inflight]
         self._inflight.clear()
         self._batches_at_snap = self.metrics.batches
         events_at = self.metrics.events
@@ -1065,10 +1113,17 @@ class FusedPipeline:
                 # replay safe); the hot loop keeps running.
                 logger.exception("Background snapshot failed")
             finally:
-                stall = time.perf_counter() - t0
+                t_done = time.perf_counter()
+                stall = t_done - t0
                 self.metrics.snapshot_stalls.append(stall)
                 if self._obs is not None:
                     self._h_snap_write.observe(stall)
+                    if self._tracer is not None:
+                        self._tracer.add_span(
+                            "snapshot_write", t0, t_done,
+                            trace_id=self._tracer.new_id(),
+                            role=self._TRACE_ROLE,
+                            args={"events_at": events_at})
 
         self._snap_thread = threading.Thread(
             target=write, name="snapshot-writer", daemon=True)
@@ -1159,11 +1214,12 @@ class FusedPipeline:
     def _checkpoint_and_ack(self) -> None:
         """Barrier: materialize all in-flight outputs, snapshot, then ack
         — every acknowledged frame is durably in the snapshot."""
-        for _, valid in self._inflight:
+        for _, valid, _ in self._inflight:
             if valid is not None:
                 jax.block_until_ready(valid)
         self.snapshot()
-        acknowledge_all(self.consumer, [msg for msg, _ in self._inflight])
+        acknowledge_all(self.consumer,
+                        [m for m, _, _ in self._inflight])
         self._inflight.clear()
 
     # -- ack draining -------------------------------------------------------
@@ -1179,7 +1235,7 @@ class FusedPipeline:
         if self.checkpointing:
             return
         while self._inflight:
-            msg, valid = self._inflight[0]
+            msg, valid, span = self._inflight[0]
             if valid is not None:
                 try:
                     ready = valid.is_ready()
@@ -1200,7 +1256,17 @@ class FusedPipeline:
                     else:
                         t_w = time.perf_counter()
                         jax.block_until_ready(valid)
-                        self._h_device.observe(time.perf_counter() - t_w)
+                        t_done = time.perf_counter()
+                        self._h_device.observe(t_done - t_w)
+                        if self._tracer is not None and span is not None:
+                            # device_wait lands AFTER its batch span
+                            # closed (pipelining) — committed with
+                            # explicit timestamps under the same trace.
+                            self._tracer.add_span(
+                                "device_wait", t_w, t_done,
+                                trace_id=span.trace_id,
+                                parent_id=span.span_id,
+                                role=self._TRACE_ROLE)
                     if block > 0:
                         block -= 1
             self.consumer.acknowledge(msg)
@@ -1242,6 +1308,22 @@ class FusedPipeline:
             # read the platform note above forbids mid-process.
             self.metrics.write_json_line(self.config.metrics_json,
                                          fpr_is_lower_bound=True)
+        if self._obs is not None:
+            self._obs.flush_trace("run-end")
+
+    def _begin_batch_span(self, msg, t_rx: float, t_got: float):
+        """Per-batch span continuing the propagated trace; redelivered
+        frames become ``retry`` siblings under the original publish
+        span (Tracer.begin_consume holds the one definition both
+        processors share)."""
+        from attendance_tpu.transport import redelivery_count
+
+        props = (msg.properties() if hasattr(msg, "properties")
+                 else None)
+        return self._tracer.begin_consume(
+            props, redelivery_count(msg), role=self._TRACE_ROLE,
+            start=t_rx, got=t_got, wait_name="dequeue_wait",
+            args={"bytes": len(msg.data())})
 
     def _run_loop(self, max_events: Optional[int],
                   idle_timeout_s: float, idle_since: float) -> None:
@@ -1252,7 +1334,8 @@ class FusedPipeline:
                 else:
                     t_rx = time.perf_counter()
                     msg = self.consumer.receive(timeout_millis=50)
-                    self._h_dequeue.observe(time.perf_counter() - t_rx)
+                    t_got = time.perf_counter()
+                    self._h_dequeue.observe(t_got - t_rx)
             except ReceiveTimeout:
                 if self.checkpointing and self._inflight:
                     self._checkpoint_and_ack()
@@ -1261,17 +1344,32 @@ class FusedPipeline:
                     break
                 continue
             idle_since = time.monotonic()
+            span = (self._begin_batch_span(msg, t_rx, t_got)
+                    if self._tracer is not None else None)
             try:
-                valid = self.process_frame(msg.data())
+                if span is None:
+                    valid = self.process_frame(msg.data())
+                else:
+                    # Activate: stage spans (decode/dispatch, sharded
+                    # replica spans) nest under the batch span; the
+                    # profiler annotation carries the trace_id into any
+                    # concurrent jax.profiler trace (correlation).
+                    with self._tracer.activate(span), annotate_trace(
+                            self._profiling, span):
+                        valid = self.process_frame(msg.data())
             except Exception:
                 # Bounded retry, then dead-letter: an undecodable frame
                 # nacked forever livelocks the subscription (the broker
                 # redelivers immediately and receive() never times out).
+                if span is not None:
+                    self._tracer.end_span(span, error=True)
                 logger.exception("Bad frame")
                 handle_poison(msg, self.consumer, self.metrics,
                               self.config, logger)
                 continue
-            self._inflight.append((msg, valid))
+            if span is not None:
+                self._tracer.end_span(span)
+            self._inflight.append((msg, valid, span))
             if self.checkpointing:
                 # Barrier on processed-batch cadence, and also on raw
                 # in-flight depth: empty frames never bump

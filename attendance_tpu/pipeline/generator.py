@@ -72,6 +72,18 @@ def generate_student_data(
     rng = random.Random(seed)
     now = now or datetime.now()
     report = GeneratorReport()
+    # Span tracing (obs/): one "generate" root span covering the whole
+    # run; each emitted message's own trace roots at the producer's
+    # publish span (memory/socket producers inject the traceparent
+    # property), so per-swipe traces stay one-per-batch while the
+    # generator's wall time is still a single slice in the timeline.
+    from attendance_tpu import obs
+    _t = obs.get()
+    tracer = _t.tracer if _t is not None else None
+    gen_span = (tracer.start_span(
+        "generate", role="generator",
+        args={"num_students": num_students}) if tracer is not None
+        else None)
 
     logger.info("Generating valid student IDs...")
     report.valid_student_ids = _sample_unique_ids(
@@ -137,4 +149,6 @@ def generate_student_data(
 
     logger.info("Total messages sent: %d (%d invalid attempts)",
                 report.message_count, report.invalid_attempts)
+    if gen_span is not None:
+        tracer.end_span(gen_span, messages=report.message_count)
     return report

@@ -164,6 +164,8 @@ class AttendanceProcessor:
         # Live telemetry (obs/), created before the transport so broker
         # queues register depth gauges; one branch per hook when off.
         self._obs = obs.ensure(self.config)
+        self._tracer = (self._obs.tracer if self._obs is not None
+                        else None)
         if self._obs is not None:
             self._h_assembly = self._obs.stage("batch_assembly")
             self._h_sketch = self._obs.stage("sketch")
@@ -348,13 +350,47 @@ class AttendanceProcessor:
         if self._obs is not None:
             self._obs.events.inc(len(events))
             self._obs.frames.inc()
-            self._obs.record_batch(
+            rec = dict(
                 ts=round(time.time(), 6), events=len(events), valid=nv,
                 invalid=len(events) - nv,
                 sketch_s=round(d_bf + d_pf, 6))
+            tr = self._tracer
+            if tr is not None:
+                cur = tr.current()
+                tid = cur.trace_id if cur is not None else tr.new_id()
+                parent = cur.span_id if cur is not None else None
+                role = "processor"
+                tr.add_span("bf_exists", t0, t0 + d_bf, trace_id=tid,
+                            parent_id=parent, role=role,
+                            args={"events": len(events)})
+                tr.add_span("persist", t_persist, t1, trace_id=tid,
+                            parent_id=parent, role=role)
+                tr.add_span("pfadd", t1, t1 + d_pf, trace_id=tid,
+                            parent_id=parent, role=role,
+                            args={"lectures": len(by_lecture)})
+                rec["trace"] = f"{tid:016x}"
+            self._obs.record_batch(**rec)
         return is_valid
 
     # -- streaming loop -----------------------------------------------------
+    def _begin_batch_span(self, msg, t_asm: float, t_got: float,
+                          n_msgs: int):
+        """Per-batch span for the generic processor. A batch mixes
+        many per-event traces; it joins the FIRST message's trace (the
+        others stay linked through the shared broker ids) — same
+        convention as the bridge. Redelivered heads become ``retry``
+        spans parented under their original publish span
+        (Tracer.begin_consume holds the one definition both
+        processors share)."""
+        from attendance_tpu.transport import redelivery_count
+
+        props = (msg.properties() if hasattr(msg, "properties")
+                 else None)
+        return self._tracer.begin_consume(
+            props, redelivery_count(msg), role="processor",
+            start=t_asm, got=t_got, wait_name="batch_assembly",
+            args={"messages": n_msgs})
+
     def _collect_batch(self) -> List:
         """Fill a batch from the consumer: up to batch_size messages, or
         whatever arrived when batch_timeout_s expires (partial batch).
@@ -373,7 +409,8 @@ class AttendanceProcessor:
             else:
                 t_asm = time.perf_counter()
                 msgs = self._collect_batch()
-                self._h_assembly.observe(time.perf_counter() - t_asm)
+                t_got = time.perf_counter()
+                self._h_assembly.observe(t_got - t_asm)
             if not msgs:
                 if pending_acks:
                     checkpoint_and_ack()
@@ -396,10 +433,22 @@ class AttendanceProcessor:
                     handle_poison(m, self.consumer, self.metrics,
                                   self.config, logger,
                                   count_nack=False)
+            span = None
+            if self._tracer is not None and good_msgs:
+                span = self._begin_batch_span(good_msgs[0], t_asm,
+                                              t_got, len(good_msgs))
             try:
-                self.process_events(events)
+                if span is None:
+                    self.process_events(events)
+                else:
+                    with self._tracer.activate(span):
+                        self.process_events(events)
+                if span is not None:
+                    self._tracer.end_span(span)
                 consecutive_failures = 0
             except Exception:
+                if span is not None:
+                    self._tracer.end_span(span, error=True)
                 # Whole-batch nack -> broker redelivery; idempotent
                 # sinks make the replay safe (SURVEY.md §5). Unlike
                 # decode poison, processing failures are usually
@@ -474,6 +523,8 @@ class AttendanceProcessor:
                     self.config.metrics_json,
                     estimated_fpr=self.estimated_fpr(),
                     fpr_is_lower_bound=blocked)
+            if self._obs is not None:
+                self._obs.flush_trace("run-end")
 
     def estimated_fpr(self) -> Optional[float]:
         """Occupancy-based Bloom FPR estimate for the roster filter

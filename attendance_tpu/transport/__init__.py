@@ -90,9 +90,9 @@ def collect_batch(consumer, batch_size: int, timeout_s: float,
     per-message receive() tops out ~0.25M msg/s on lock round-trips
     alone); per-message receive is the fallback for clients without it
     (the gated real-Pulsar wrapper). ``raw=True`` selects the memory
-    broker's zero-wrapper lane — ``(message_id, data, redeliveries)``
-    tuples instead of Message objects; the caller must have
-    feature-detected receive_many_raw."""
+    broker's zero-wrapper lane — ``(message_id, data, redeliveries,
+    properties)`` tuples instead of Message objects; the caller must
+    have feature-detected receive_many_raw."""
     batch_recv = (consumer.receive_many_raw if raw
                   else getattr(consumer, "receive_many", None))
     msgs = []

@@ -34,17 +34,26 @@ class ReceiveTimeout(Exception):
 
 
 class Message:
-    """A delivered message: payload bytes + broker bookkeeping ids."""
+    """A delivered message: payload bytes + broker bookkeeping ids +
+    optional string properties (the Pulsar message-properties slice the
+    trace context travels in — properties survive redelivery and crash
+    takeover exactly like the payload)."""
 
-    __slots__ = ("_data", "message_id", "redelivery_count")
+    __slots__ = ("_data", "message_id", "redelivery_count", "_props")
 
-    def __init__(self, data: bytes, message_id: int, redelivery_count: int):
+    def __init__(self, data: bytes, message_id: int,
+                 redelivery_count: int, properties: Optional[dict] = None):
         self._data = data
         self.message_id = message_id
         self.redelivery_count = redelivery_count
+        self._props = properties
 
     def data(self) -> bytes:
         return self._data
+
+    def properties(self) -> dict:
+        """Producer-attached string properties (pulsar.Message shape)."""
+        return self._props or {}
 
 
 class _Subscription:
@@ -100,9 +109,11 @@ class _Subscription:
         self._blocks: Deque[list] = deque()
         self._tail: list = []
         self._count = 0
-        # message_id -> (payload, redeliveries, owner consumer id)
-        self.inflight: Dict[int, Tuple[bytes, int, int]] = {}
-        # chunk_id -> (list of (mid, payload, red), owner) — the chunk
+        # message_id -> (payload, redeliveries, owner consumer id,
+        # properties)
+        self.inflight: Dict[int, Tuple[bytes, int, int, Optional[dict]]] = {}
+        # chunk_id -> (list of (mid, payload, red, props), owner) — the
+        # chunk
         # lane's whole-batch in-flight entries (see receive_chunk).
         self.chunk_inflight: Dict[int, Tuple[list, int]] = {}
         self._chunk_ids = itertools.count()
@@ -121,7 +132,8 @@ class _Subscription:
             self.cond.notify(min(self._waiting, n))
 
     # -- pending-queue internals (cond held) --------------------------------
-    def _append_one(self, entry: Tuple[int, bytes, int]) -> None:
+    def _append_one(self, entry: Tuple[int, bytes, int,
+                                       Optional[dict]]) -> None:
         self._tail.append(entry)
         self._count += 1
 
@@ -163,13 +175,15 @@ class _Subscription:
             return parts[0]
         return [t for p in parts for t in p]
 
-    def enqueue(self, message_id: int, data: bytes, redeliveries: int = 0):
+    def enqueue(self, message_id: int, data: bytes, redeliveries: int = 0,
+                properties: Optional[dict] = None):
         with self.cond:
-            self._append_one((message_id, data, redeliveries))
+            self._append_one((message_id, data, redeliveries, properties))
             self._notify_if_waiting()
 
     def enqueue_many(self, entries) -> None:
-        """Bulk enqueue of (mid, data, redeliveries) tuples: one lock
+        """Bulk enqueue of (mid, data, redeliveries, properties)
+        tuples: one lock
         acquisition, one block handover, one notify per waiting
         consumer it can feed. The subscription takes OWNERSHIP of a
         list argument (whole-block pops hand it back out); callers
@@ -188,14 +202,15 @@ class _Subscription:
     def receive_many_raw(self, max_n: int, timeout_s: Optional[float],
                          owner: int) -> list:
         """Drain up to max_n pending messages under ONE lock
-        acquisition, returning raw ``(message_id, data, redeliveries)``
-        tuples — the zero-wrapper lane for batching consumers whose
+        acquisition, returning raw ``(message_id, data, redeliveries,
+        properties)`` tuples — the zero-wrapper lane for batching consumers whose
         per-event budget is microseconds (the JSON bridge). Blocks
         until at least one message is available or the timeout
         expires."""
         def register(popped):
             self.inflight.update(
-                (mid, (data, red, owner)) for mid, data, red in popped)
+                (mid, (data, red, owner, props))
+                for mid, data, red, props in popped)
 
         return self._pop_pending(max_n, timeout_s, register)
 
@@ -232,7 +247,7 @@ class _Subscription:
             if self._obs_recv_msgs is not None:
                 self._obs_recv_msgs.inc(len(popped))
                 self._obs_recv_bytes.inc(
-                    sum(len(data) for _, data, _ in popped))
+                    sum(len(t[1]) for t in popped))
             return popped
 
     def receive_chunk(self, max_n: int, timeout_s: Optional[float],
@@ -264,8 +279,8 @@ class _Subscription:
         with self.cond:
             entry = self.chunk_inflight.pop(chunk_id, None)
             if entry is not None:
-                requeued = [(mid, data, red + 1)
-                            for mid, data, red in entry[0]]
+                requeued = [(mid, data, red + 1, props)
+                            for mid, data, red, props in entry[0]]
                 self._append_block(requeued)
                 self._notify_if_waiting(len(requeued))
                 if self._obs_redelivered is not None:
@@ -280,13 +295,15 @@ class _Subscription:
             if entry is not None:
                 popped, owner = entry
                 self.inflight.update(
-                    (mid, (data, red, owner)) for mid, data, red in popped)
+                    (mid, (data, red, owner, props))
+                    for mid, data, red, props in popped)
 
     def receive_many(self, max_n: int, timeout_s: Optional[float],
                      owner: int) -> list:
         """Like receive_many_raw, wrapped in Message objects (the
         Pulsar batch_receive shape); receive() is the max_n=1 case."""
-        return [Message(data, mid, red) for mid, data, red
+        return [Message(data, mid, red, props)
+                for mid, data, red, props
                 in self.receive_many_raw(max_n, timeout_s, owner)]
 
     def acknowledge(self, message_id: int) -> None:
@@ -302,8 +319,9 @@ class _Subscription:
         with self.cond:
             entry = self.inflight.pop(message_id, None)
             if entry is not None:
-                data, redeliveries, _ = entry
-                self._append_one((message_id, data, redeliveries + 1))
+                data, redeliveries, _, props = entry
+                self._append_one((message_id, data, redeliveries + 1,
+                                  props))
                 self._notify_if_waiting()
                 if self._obs_redelivered is not None:
                     self._obs_redelivered.inc()
@@ -313,11 +331,12 @@ class _Subscription:
         messages (per-message AND chunk entries) to the queue; other
         consumers' deliveries stay theirs."""
         with self.cond:
-            mine = [(mid, d, r) for mid, (d, r, o) in self.inflight.items()
+            mine = [(mid, d, r, p)
+                    for mid, (d, r, o, p) in self.inflight.items()
                     if o == owner]
-            for mid, data, redeliveries in mine:
+            for mid, data, redeliveries, props in mine:
                 del self.inflight[mid]
-                self._append_one((mid, data, redeliveries + 1))
+                self._append_one((mid, data, redeliveries + 1, props))
             my_chunks = [cid for cid, (_, o) in self.chunk_inflight.items()
                          if o == owner]
             chunk_msgs = 0
@@ -325,7 +344,8 @@ class _Subscription:
                 popped, _ = self.chunk_inflight.pop(cid)
                 chunk_msgs += len(popped)
                 self._append_block(
-                    [(mid, data, red + 1) for mid, data, red in popped])
+                    [(mid, data, red + 1, props)
+                     for mid, data, red, props in popped])
             if mine or my_chunks:
                 self.cond.notify_all()
                 if self._obs_redelivered is not None:
@@ -343,7 +363,10 @@ class _Topic:
         self.name = name
         self.lock = threading.Lock()
         self.subscriptions: Dict[str, _Subscription] = {}
-        self.retained: Deque[Tuple[int, bytes]] = deque(maxlen=RETAINED_LIMIT)
+        # (mid, data, properties) — retention keeps properties so late
+        # subscribers still see the trace context.
+        self.retained: Deque[Tuple[int, bytes, Optional[dict]]] = deque(
+            maxlen=RETAINED_LIMIT)
         self._ids = itertools.count()
 
     def subscription(self, name: str) -> _Subscription:
@@ -354,30 +377,35 @@ class _Topic:
                     name, topic=self.name)
                 # A new subscription starts at the earliest retained
                 # message (the generator may run before the processor).
-                sub.enqueue_many([(mid, data, 0)
-                                  for mid, data in self.retained])
+                sub.enqueue_many([(mid, data, 0, props)
+                                  for mid, data, props in self.retained])
             return sub
 
-    def publish(self, data: bytes) -> int:
+    def publish(self, data: bytes,
+                properties: Optional[dict] = None) -> int:
         with self.lock:
             mid = next(self._ids)
-            self.retained.append((mid, data))
+            self.retained.append((mid, data, properties))
             subs = list(self.subscriptions.values())
         for sub in subs:
-            sub.enqueue(mid, data)
+            sub.enqueue(mid, data, properties=properties)
         return mid
 
-    def publish_many(self, datas) -> int:
+    def publish_many(self, datas, properties=None) -> int:
         """Bulk publish: one id/retention pass and one enqueue_many per
         subscription for the whole batch (per-message publish pays a
         lock round-trip per message — at JSON-wire rates that alone is
-        ~1.4us/message). Returns the FIRST assigned message id; ids are
-        consecutive."""
+        ~1.4us/message). ``properties`` is an optional per-message list
+        aligned with ``datas``. Returns the FIRST assigned message id;
+        ids are consecutive."""
+        if properties is None:
+            properties = [None] * len(datas)
         with self.lock:
-            entries = [(next(self._ids), bytes(d)) for d in datas]
+            entries = [(next(self._ids), bytes(d), p)
+                       for d, p in zip(datas, properties)]
             self.retained.extend(entries)
             subs = list(self.subscriptions.values())
-        tuples = [(mid, d, 0) for mid, d in entries]
+        tuples = [(mid, d, 0, p) for mid, d, p in entries]
         # Each subscription takes ownership of its block (whole-block
         # pops hand the list back out): one shared list across subs
         # would alias a consumer's returned batch with another sub's
@@ -421,8 +449,12 @@ class MemoryProducer:
     def __init__(self, topic: _Topic):
         self._topic = topic
         self._closed = False
+        self._seq = itertools.count()
         from attendance_tpu import obs
         t = obs.get()
+        # Captured ONCE (the obs/ discipline): with telemetry off —
+        # or metrics-only — every send below pays one branch.
+        self._tracer = t.tracer if t is not None else None
         if t is not None:
             self._obs_msgs = t.registry.counter(
                 "attendance_broker_sent_messages_total",
@@ -434,24 +466,41 @@ class MemoryProducer:
             self._obs_msgs = None
             self._obs_bytes = None
 
-    def send(self, data: bytes) -> int:
+    def send(self, data: bytes, properties: Optional[dict] = None) -> int:
         if self._closed:
             raise RuntimeError("producer closed")
         if self._obs_msgs is not None:
             self._obs_msgs.inc()
             self._obs_bytes.inc(len(data))
-        return self._topic.publish(bytes(data))
+        if self._tracer is not None:
+            # Root (or continue) the message's trace and carry the
+            # context in the message properties — the Dapper hop.
+            span, properties = self._tracer.begin_publish(
+                self._topic.name, next(self._seq), properties)
+            try:
+                return self._topic.publish(bytes(data), properties)
+            finally:
+                self._tracer.end_span(span)
+        return self._topic.publish(bytes(data), properties)
 
-    def send_many(self, datas) -> int:
+    def send_many(self, datas, properties=None) -> int:
         """Bulk send (memory-broker extension; callers feature-detect):
-        one broker pass for the whole batch. Returns the first id."""
+        one broker pass for the whole batch. ``properties`` is an
+        optional per-message list. Returns the first id."""
         if self._closed:
             raise RuntimeError("producer closed")
         if self._obs_msgs is not None:
             datas = [bytes(d) for d in datas]
             self._obs_msgs.inc(len(datas))
             self._obs_bytes.inc(sum(len(d) for d in datas))
-        return self._topic.publish_many(datas)
+        if self._tracer is not None and properties is None:
+            span, properties = self._tracer.begin_publish_many(
+                self._topic.name, next(self._seq), len(datas))
+            try:
+                return self._topic.publish_many(datas, properties)
+            finally:
+                self._tracer.end_span(span)
+        return self._topic.publish_many(datas, properties)
 
     def flush(self) -> None:
         pass
@@ -487,10 +536,10 @@ class MemoryConsumer:
 
     def receive_many_raw(self, max_n: int,
                          timeout_millis: Optional[int] = None) -> list:
-        """Batch receive as raw (message_id, data, redeliveries)
-        tuples — no Message wrappers. Ack with acknowledge_ids;
-        reconstruct a Message(data, message_id, redeliveries) only on
-        the poison path. Memory-broker extension (the real pulsar
+        """Batch receive as raw (message_id, data, redeliveries,
+        properties) tuples — no Message wrappers. Ack with
+        acknowledge_ids; reconstruct a Message(data, message_id,
+        redeliveries) only on the poison path. Memory-broker extension (the real pulsar
         client has no such lane; callers feature-detect)."""
         if self._closed:
             raise RuntimeError("consumer closed")

@@ -20,10 +20,16 @@ Protocol (little-endian): request = u8 opcode, u32 body_len, body;
 reply = u8 status (0 ok / 1 timeout / 2 error), u32 body_len, body.
 One in-flight request per connection (synchronous RPC); batch receives
 amortize the round-trip exactly like the in-process batch lanes.
+Message properties (the trace-context carrier) ride as a u32-length-
+prefixed JSON dict next to each payload in both directions (length 0 =
+no properties), so trace context survives the TCP hop, redelivery, and
+crash takeover exactly like in-process.
 """
 
 from __future__ import annotations
 
+import itertools
+import json
 import logging
 import socket
 import struct
@@ -81,6 +87,23 @@ def _send_frame(sock: socket.socket, code: int, body: bytes) -> None:
 def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
     code, blen = _HDR.unpack(_recv_exact(sock, _HDR.size))
     return code, _recv_exact(sock, blen) if blen else b""
+
+
+def _enc_props(props) -> bytes:
+    """u32-length-prefixed JSON dict; empty/None = zero length."""
+    if not props:
+        return struct.pack("<I", 0)
+    body = json.dumps(props, separators=(",", ":")).encode()
+    return struct.pack("<I", len(body)) + body
+
+
+def _dec_props(body: bytes, off: int):
+    """-> (props_or_None, next_offset)."""
+    (plen,) = struct.unpack_from("<I", body, off)
+    off += 4
+    if not plen:
+        return None, off
+    return json.loads(body[off:off + plen]), off + plen
 
 
 class BrokerServer:
@@ -181,8 +204,9 @@ class BrokerServer:
         if op == _OP_PRODUCE:
             (tlen,) = struct.unpack_from("<H", body)
             topic = body[2:2 + tlen].decode()
-            payload = body[2 + tlen:]
-            mid = self.broker.topic(topic).publish(payload)
+            props, off = _dec_props(body, 2 + tlen)
+            payload = body[off:]
+            mid = self.broker.topic(topic).publish(payload, props)
             return _ST_OK, struct.pack("<Q", mid)
         if op == _OP_SUBSCRIBE:
             (tlen,) = struct.unpack_from("<H", body)
@@ -211,13 +235,15 @@ class BrokerServer:
             off = 2 + tlen
             (count,) = struct.unpack_from("<I", body, off)
             off += 4
-            datas = []
+            datas, props = [], []
             for _ in range(count):
+                p, off = _dec_props(body, off)
+                props.append(p)
                 (dlen,) = struct.unpack_from("<I", body, off)
                 off += 4
                 datas.append(body[off:off + dlen])
                 off += dlen
-            first = self.broker.topic(topic).publish_many(datas)
+            first = self.broker.topic(topic).publish_many(datas, props)
             return _ST_OK, struct.pack("<q", first)
         if op in (_OP_RECEIVE, _OP_RECEIVE_CHUNK):
             handle, max_n, timeout_ms = struct.unpack("<IIi", body)
@@ -234,8 +260,9 @@ class BrokerServer:
             except ReceiveTimeout:
                 return _ST_TIMEOUT, b""
             parts = [struct.pack("<QI", cid, len(msgs))]
-            for mid, data, red in msgs:
+            for mid, data, red, props in msgs:
                 parts.append(struct.pack("<QII", mid, red, len(data)))
+                parts.append(_enc_props(props))
                 parts.append(data)
             return _ST_OK, b"".join(parts)
         if op == _OP_ACK_CHUNK:
@@ -333,13 +360,16 @@ def _check(status: int, reply: bytes) -> bytes:
 class SocketProducer:
     def __init__(self, rpc: _Rpc, topic: str):
         self._rpc = rpc
+        self._topic = topic
         t = topic.encode()
         self._prefix = struct.pack("<H", len(t)) + t
         self._closed = False
+        self._seq = itertools.count()
         # Client-side telemetry (obs/): wire traffic as seen by THIS
         # process (the server's own broker carries the queue gauges).
         from attendance_tpu import obs
         tel = obs.get()
+        self._tracer = tel.tracer if tel is not None else None
         if tel is not None:
             self._obs_msgs = tel.registry.counter(
                 "attendance_socket_sent_messages_total",
@@ -352,32 +382,54 @@ class SocketProducer:
             self._obs_msgs = None
             self._obs_bytes = None
 
-    def send(self, data: bytes) -> int:
+    def send(self, data: bytes, properties=None) -> int:
         if self._closed:
             raise RuntimeError("producer closed")
         if self._obs_msgs is not None:
             self._obs_msgs.inc()
             self._obs_bytes.inc(len(data))
-        status, reply = self._rpc.call(_OP_PRODUCE,
-                                       self._prefix + bytes(data))
+        span = None
+        if self._tracer is not None:
+            span, properties = self._tracer.begin_publish(
+                self._topic, next(self._seq), properties)
+        try:
+            status, reply = self._rpc.call(
+                _OP_PRODUCE,
+                self._prefix + _enc_props(properties) + bytes(data))
+        finally:
+            if span is not None:
+                self._tracer.end_span(span)
         (mid,) = struct.unpack("<Q", _check(status, reply))
         return mid
 
-    def send_many(self, datas) -> int:
+    def send_many(self, datas, properties=None) -> int:
         """Bulk send: ONE round-trip and one broker pass for the whole
         batch (mirrors the memory producer's send_many; callers
-        feature-detect). Returns the first assigned id."""
+        feature-detect). ``properties`` is an optional per-message
+        list. Returns the first assigned id."""
         if self._closed:
             raise RuntimeError("producer closed")
         datas = [bytes(d) for d in datas]
         if self._obs_msgs is not None:
             self._obs_msgs.inc(len(datas))
             self._obs_bytes.inc(sum(len(d) for d in datas))
+        span = None
+        if self._tracer is not None and properties is None:
+            span, properties = self._tracer.begin_publish_many(
+                self._topic, next(self._seq), len(datas))
+        if properties is None:
+            properties = [None] * len(datas)
         parts = [self._prefix, struct.pack("<I", len(datas))]
-        for d in datas:
+        for d, p in zip(datas, properties):
+            parts.append(_enc_props(p))
             parts.append(struct.pack("<I", len(d)))
             parts.append(d)
-        status, reply = self._rpc.call(_OP_PRODUCE_MANY, b"".join(parts))
+        try:
+            status, reply = self._rpc.call(_OP_PRODUCE_MANY,
+                                           b"".join(parts))
+        finally:
+            if span is not None:
+                self._tracer.end_span(span)
         (first,) = struct.unpack("<q", _check(status, reply))
         return first
 
@@ -458,11 +510,12 @@ class SocketConsumer:
             for _ in range(count):
                 mid, red, dlen = struct.unpack_from("<QII", body, off)
                 off += 16
-                out.append((mid, body[off:off + dlen], red))
+                props, off = _dec_props(body, off)
+                out.append((mid, body[off:off + dlen], red, props))
                 off += dlen
             if self._obs_msgs is not None:
                 self._obs_msgs.inc(count)
-                self._obs_bytes.inc(sum(len(d) for _, d, _ in out))
+                self._obs_bytes.inc(sum(len(t[1]) for t in out))
             return cid, out
 
     def receive_many_raw(self, max_n: int,
@@ -493,7 +546,7 @@ class SocketConsumer:
 
     def receive_many(self, max_n: int,
                      timeout_millis: Optional[int] = None) -> list:
-        return [Message(data, mid, red) for mid, data, red
+        return [Message(data, mid, red, props) for mid, data, red, props
                 in self.receive_many_raw(max_n, timeout_millis)]
 
     def receive(self, timeout_millis: Optional[int] = None) -> Message:

@@ -35,3 +35,16 @@ def maybe_annotate(enabled: bool, name: str):
     import jax
 
     return jax.profiler.TraceAnnotation(name)
+
+
+def annotate_trace(enabled: bool, span):
+    """Correlation hook between the span tracer (obs/tracing.py) and a
+    jax.profiler trace: annotate the device work of one batch with its
+    trace_id, so an XProf timeline slice and a --trace-out span tree
+    name the same trace. Nullcontext unless BOTH a profile run and a
+    traced batch are active."""
+    if not enabled or span is None:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(f"trace:{span.trace_id:016x}")

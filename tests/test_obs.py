@@ -162,6 +162,58 @@ def test_prom_table_formatter(tmp_path):
     assert "count=3" in table  # histogram folded to count/sum/mean
 
 
+def test_gauge_callback_raising_at_scrape_is_skipped_with_warning(
+        caplog):
+    """One bad device read (a raising health/queue callback) must not
+    500 the endpoint or abort the prom append — its sample is skipped
+    with a warning; every other metric still renders."""
+    import logging
+
+    reg = Registry()
+    reg.counter("ok_total").inc(5)
+    reg.gauge("bad_gauge", key="a").set_function(
+        lambda: (_ for _ in ()).throw(RuntimeError("device gone")))
+    reg.gauge("bad_gauge", key="b").set(3)
+    with caplog.at_level(logging.WARNING,
+                         logger="attendance_tpu.obs.exposition"):
+        text = render(reg)
+    assert "ok_total 5" in text
+    assert 'bad_gauge{key="b"} 3' in text
+    assert 'key="a"' not in text  # the raising sample is skipped...
+    assert any("raised at scrape time" in r.message
+               for r in caplog.records)  # ...loudly
+
+
+def test_gauge_nan_inf_render_per_prometheus_text_rules():
+    reg = Registry()
+    reg.gauge("g", k="nan").set(float("nan"))
+    reg.gauge("g", k="pinf").set(float("inf"))
+    reg.gauge("g", k="ninf").set(float("-inf"))
+    text = render(reg)
+    assert 'g{k="nan"} NaN' in text
+    assert 'g{k="pinf"} +Inf' in text
+    assert 'g{k="ninf"} -Inf' in text
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            assert _SAMPLE_RE.match(line), line
+
+
+def test_http_endpoint_survives_raising_gauge():
+    t = obs.enable(Config(metrics_port=-1))
+    t.registry.gauge("doomed").set_function(
+        lambda: (_ for _ in ()).throw(OSError("no device")))
+    t.events.inc(3)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{t.http_port}/metrics", timeout=10) as r:
+        assert r.status == 200
+        body = r.read().decode()
+    assert "attendance_events_total 3" in body
+    # No lying sample line: the raising gauge contributes at most its
+    # HELP/TYPE comments, never a value.
+    assert not [l for l in body.splitlines()
+                if l.startswith("doomed ")]
+
+
 # -- flight recorder ---------------------------------------------------------
 
 def test_flight_ring_wraps_in_order():

@@ -1,0 +1,393 @@
+"""Span tracing + sketch-health tests (the PR-2 observability layer).
+
+Covers the tracer core (context codec, bounded buffer, deterministic
+Chrome-trace export against a golden file), trace-context propagation
+through broker message properties (memory AND socket, surviving nack
+redelivery), the acceptance scenario (a traced fused run produces a
+Perfetto-loadable trace with >= 5 distinct stage spans per batch under
+one trace_id per published frame, redeliveries as retry child spans),
+and the sketch-health gauges (values match the models' own estimators
+to float tolerance; no device work happens before a scrape).
+"""
+
+import itertools
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from attendance_tpu import obs
+from attendance_tpu.config import Config
+from attendance_tpu.obs.tracing import (
+    TRACEPARENT, SpanContext, Tracer, format_ctx, parse_ctx)
+from attendance_tpu.transport.memory_broker import (
+    MemoryBroker, MemoryClient)
+
+GOLDEN = Path(__file__).parent / "data" / "trace_export.golden"
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    obs.disable()
+    yield
+    obs.disable()
+
+
+# -- context codec -----------------------------------------------------------
+
+def test_ctx_roundtrip_and_malformed():
+    ctx = SpanContext(0xdeadbeef, 0x1234, 17)
+    assert parse_ctx(format_ctx(ctx)) == ctx
+    # Malformed values degrade to "fresh trace", never an exception —
+    # a traced consumer must interoperate with anything upstream.
+    for bad in (None, "", "zz", "1-2", "x-y-z", 42, "1-2-3-4"):
+        assert parse_ctx(bad) is None
+
+
+# -- tracer core -------------------------------------------------------------
+
+def test_span_buffer_is_bounded():
+    tr = Tracer(limit=4)
+    for i in range(7):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr) == 4
+    assert tr.dropped == 3
+    assert tr.export()["otherData"]["dropped_spans"] == 3
+
+
+def test_activate_nests_spans_and_exceptions_are_recorded():
+    tr = Tracer()
+    with tr.span("outer") as outer:
+        with tr.span("inner"):
+            pass
+    inner = [s for s in tr.snapshot() if s.name == "inner"][0]
+    assert inner.trace_id == outer.trace_id
+    assert inner.parent_id == outer.span_id
+    with pytest.raises(RuntimeError):
+        with tr.span("boom"):
+            raise RuntimeError("x")
+    boom = [s for s in tr.snapshot() if s.name == "boom"][0]
+    assert "RuntimeError" in boom.args["error"]
+
+
+def _deterministic_tracer() -> Tracer:
+    ids = itertools.count(1)
+    return Tracer(_clock=lambda: 0.0, _ids=lambda: next(ids),
+                  _epoch=0.0)
+
+
+def test_chrome_export_matches_golden_file():
+    """The export format IS the contract (Perfetto loads it byte for
+    byte); pin it with a golden file built from injected ids/clock."""
+    tr = _deterministic_tracer()
+    pub = tr.add_span("publish", 0.0, 0.0005, trace_id=1,
+                      role="producer", args={"topic": "t", "seq": 0})
+    batch = tr.add_span("batch", 0.001, 0.009, trace_id=1,
+                        parent_id=pub.span_id, role="fused-pipeline",
+                        args={"seq": 0})
+    tr.add_span("decode", 0.001, 0.002, trace_id=1,
+                parent_id=batch.span_id, role="fused-pipeline")
+    tr.add_span("dispatch", 0.002, 0.009, trace_id=1,
+                parent_id=batch.span_id, role="fused-pipeline",
+                args={"wire": "word"})
+    doc = tr.export()
+    doc.pop("otherData")  # carries the live pid
+    rendered = json.dumps(doc, indent=1, sort_keys=True) + "\n"
+    assert rendered == GOLDEN.read_text()
+
+
+def test_export_loads_as_chrome_trace_shape():
+    tr = _deterministic_tracer()
+    with tr.span("a", role="r1"):
+        pass
+    doc = json.loads(json.dumps(tr.export()))  # JSON-serializable
+    assert doc["displayTimeUnit"] == "ms"
+    slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert slices and metas
+    for e in slices:  # every slice has the linking args
+        assert {"pid", "tid", "ts", "dur", "name", "args"} <= set(e)
+        assert "trace_id" in e["args"] and "span_id" in e["args"]
+
+
+# -- propagation: memory broker ----------------------------------------------
+
+def test_memory_broker_properties_survive_nack_and_takeover():
+    client = MemoryClient(MemoryBroker())
+    producer = client.create_producer("t")
+    consumer = client.subscribe("t", "s")
+    producer.send(b"payload", properties={TRACEPARENT: "aa-bb-cc",
+                                          "k": "v"})
+    msg = consumer.receive(timeout_millis=500)
+    assert msg.properties() == {TRACEPARENT: "aa-bb-cc", "k": "v"}
+    consumer.negative_acknowledge(msg)
+    again = consumer.receive(timeout_millis=500)
+    assert again.redelivery_count == 1
+    assert again.properties() == msg.properties()
+    # Crash takeover keeps them too.
+    consumer.close()
+    survivor = client.subscribe("t", "s")
+    taken = survivor.receive(timeout_millis=500)
+    assert taken.redelivery_count == 2
+    assert taken.properties()["k"] == "v"
+
+
+def test_producer_injects_traceparent_when_tracing(tmp_path):
+    t = obs.enable(Config(trace_out=str(tmp_path / "t.json")))
+    client = MemoryClient(MemoryBroker())
+    client.create_producer("t").send(b"x")
+    msg = client.subscribe("t", "s").receive(timeout_millis=500)
+    ctx = parse_ctx(msg.properties()[TRACEPARENT])
+    assert ctx is not None
+    # ...and the publish span it names is in the buffer.
+    pub = [s for s in t.tracer.snapshot() if s.name == "publish"]
+    assert pub and pub[0].span_id == ctx.span_id
+    assert pub[0].trace_id == ctx.trace_id
+
+
+# -- propagation: socket broker (incl. forced redelivery) --------------------
+
+def test_socket_broker_propagates_properties_across_redelivery():
+    from attendance_tpu.transport.socket_broker import (
+        BrokerServer, SocketClient)
+
+    server = BrokerServer().start()
+    try:
+        client = SocketClient(server.address)
+        producer = client.create_producer("t")
+        consumer = client.subscribe("t", "s")
+        producer.send(b"one", properties={TRACEPARENT: "11-22-0"})
+        producer.send_many([b"two", b"three"],
+                           properties=[{"n": "2"}, None])
+        msg = consumer.receive(timeout_millis=2000)
+        assert msg.properties() == {TRACEPARENT: "11-22-0"}
+        # Forced redelivery over TCP: the nack only ships the id; the
+        # server's subscription re-derives payload AND properties.
+        consumer.negative_acknowledge(msg)
+        msgs = consumer.receive_many(3, timeout_millis=2000)
+        by_data = {m.data(): m for m in msgs}
+        assert by_data[b"two"].properties() == {"n": "2"}
+        assert by_data[b"three"].properties() == {}
+        redelivered = by_data.get(b"one")
+        if redelivered is None:  # not in the first drain: fetch it
+            redelivered = consumer.receive(timeout_millis=2000)
+        assert redelivered.data() == b"one"
+        assert redelivered.redelivery_count == 1
+        assert redelivered.properties() == {TRACEPARENT: "11-22-0"}
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_retry_span_parents_under_publish_across_socket(tmp_path):
+    """A frame that fails decode is nacked and redelivered; every
+    redelivered attempt must appear as a ``retry`` span parented under
+    the SAME publish span as the first attempt — across the socket
+    broker, whose properties ride the TCP protocol."""
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.transport.socket_broker import (
+        BrokerServer, SocketClient)
+
+    trace_path = tmp_path / "trace.json"
+    config = Config(bloom_filter_capacity=1_000,
+                    transport_backend="socket",
+                    trace_out=str(trace_path), max_redeliveries=2)
+    t = obs.enable(config)
+    server = BrokerServer().start()
+    try:
+        client = SocketClient(server.address)
+        pipe = FusedPipeline(config, client=client, num_banks=4)
+        SocketClient(server.address).create_producer(
+            config.pulsar_topic).send(b"garbage-not-a-frame")
+        pipe.run(max_events=1, idle_timeout_s=0.5)
+        assert pipe.metrics.dead_lettered == 1
+        pipe.cleanup()
+    finally:
+        server.stop()
+    doc = json.loads(trace_path.read_text())
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    pub = [e for e in evs if e["name"] == "publish"]
+    retries = [e for e in evs if e["name"] == "retry"]
+    batches = [e for e in evs if e["name"] == "batch"]
+    assert len(pub) == 1 and len(batches) == 1  # first attempt
+    assert len(retries) == 2  # max_redeliveries=2 retry attempts
+    pub_span = pub[0]["args"]["span_id"]
+    pub_trace = pub[0]["args"]["trace_id"]
+    for e in retries + batches:
+        assert e["args"]["trace_id"] == pub_trace
+        assert e["args"]["parent_span_id"] == pub_span
+    assert [e["args"]["redelivery"] for e in retries] == [1, 2]
+
+
+# -- the acceptance scenario -------------------------------------------------
+
+def _run_traced_fused(tmp_path, num_events=4_096, frame=1_024,
+                      flight=0):
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+
+    trace_path = tmp_path / "trace.json"
+    config = Config(bloom_filter_capacity=5_000,
+                    trace_out=str(trace_path), flight_recorder=flight,
+                    flight_path=str(tmp_path / "flight.json"))
+    t = obs.enable(config)
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    roster, frames = generate_frames(num_events, frame,
+                                     roster_size=4_000, num_lectures=4)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=num_events, idle_timeout_s=0.3)
+    return t, pipe, trace_path
+
+
+def test_traced_fused_run_links_stage_spans_per_batch(tmp_path):
+    t, pipe, trace_path = _run_traced_fused(tmp_path)
+    doc = json.loads(trace_path.read_text())
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    by_trace = {}
+    for e in evs:
+        by_trace.setdefault(e["args"]["trace_id"], set()).add(e["name"])
+    # One trace per published frame, each with >= 5 distinct stage
+    # spans (publish -> batch -> dequeue_wait/decode/dispatch[...]).
+    batch_traces = [names for names in by_trace.values()
+                    if "batch" in names]
+    assert len(batch_traces) == 4
+    for names in batch_traces:
+        assert {"publish", "batch", "dequeue_wait", "decode",
+                "dispatch"} <= names
+        assert len(names) >= 5
+    # Roles separate into per-role pids with process_name metadata.
+    roles = {e["args"]["name"]
+             for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert {"producer", "fused-pipeline"} <= roles
+
+
+def test_flight_recorder_records_cross_reference_traces(tmp_path):
+    t, pipe, trace_path = _run_traced_fused(tmp_path, flight=16)
+    t.dump_flight("test")
+    doc = json.loads((tmp_path / "flight.json").read_text())
+    traces = {r["trace"] for r in doc["records"]}
+    assert len(traces) == 4  # one trace per frame
+    exported = {e["args"]["trace_id"]
+                for e in json.loads(trace_path.read_text())
+                ["traceEvents"] if e.get("ph") == "X"}
+    assert traces <= exported
+
+
+def test_bridge_relays_trace_context_end_to_end(tmp_path):
+    """generator-wire JSON -> bridge -> fused pipeline is ONE trace:
+    the frame's batch span shares the first JSON message's trace_id."""
+    import dataclasses
+
+    from attendance_tpu.pipeline.bridge import JsonBinaryBridge
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.events import encode_event
+    from attendance_tpu.pipeline.generator import generate_student_data
+
+    trace_path = tmp_path / "trace.json"
+    config = Config(bloom_filter_capacity=2_000, batch_size=512,
+                    trace_out=str(trace_path))
+    t = obs.enable(config)
+    broker = MemoryBroker()
+    bridge = JsonBinaryBridge(config, client=MemoryClient(broker))
+    pipe = FusedPipeline(
+        dataclasses.replace(config, pulsar_topic=bridge.out_topic),
+        client=MemoryClient(broker), num_banks=8)
+    report = generate_student_data(
+        producer=MemoryClient(broker).create_producer(
+            config.pulsar_topic),
+        num_students=40, seed=7)
+    bridge.run(max_events=report.message_count, idle_timeout_s=0.3)
+    pipe.run(max_events=report.message_count, idle_timeout_s=0.3)
+    spans = t.tracer.snapshot()
+    forwards = [s for s in spans if s.name == "bridge_forward"]
+    batches = [s for s in spans if s.name == "batch"]
+    assert forwards and batches
+    # Each fused batch span's trace is one a bridge_forward belongs to.
+    fwd_traces = {s.trace_id for s in forwards}
+    assert {s.trace_id for s in batches} <= fwd_traces
+    # And that trace roots at a generator-side publish span.
+    pub_traces = {s.trace_id for s in spans if s.name == "publish"}
+    assert fwd_traces <= pub_traces
+
+
+# -- sketch-health gauges ----------------------------------------------------
+
+def test_sketch_health_gauges_match_model_estimators(tmp_path):
+    from attendance_tpu.obs.exposition import parse_prom, render
+
+    t, pipe, _ = _run_traced_fused(tmp_path)
+    samples = {n: float(v) for n, _, v in parse_prom(render(t.registry))}
+    assert samples["attendance_bloom_estimated_fpr"] == pytest.approx(
+        pipe.estimated_fpr(), rel=1e-6)
+    assert samples["attendance_bloom_fill_fraction"] == pytest.approx(
+        pipe.estimated_fpr() ** (1.0 / pipe.params.k), rel=1e-6)
+    assert samples["attendance_hll_estimate"] == pytest.approx(
+        sum(pipe.count_all().values()), abs=1.0)
+    assert samples["attendance_hll_saturated_registers"] == 0.0
+
+
+def test_bloom_filter_gauge_tracks_estimated_fpr_after_inserts(
+        tmp_path):
+    from attendance_tpu.models.bloom import BloomFilter
+    from attendance_tpu.obs import health
+    from attendance_tpu.obs.exposition import parse_prom, render
+
+    t = obs.enable(Config(flight_recorder=4,
+                          flight_path=str(tmp_path / "f.json")))
+    bf = BloomFilter(capacity=5_000, error_rate=0.01)
+    health.register_bloom_filter(t, bf, key="bf:test")
+    rng = np.random.default_rng(3)
+    for _ in range(3):  # N inserts in chunks; the gauge tracks live
+        bf.add(rng.integers(0, 1 << 31, 1_000, dtype=np.uint32))
+        samples = {n: float(v)
+                   for n, _, v in parse_prom(render(t.registry))}
+        assert samples["attendance_bloom_estimated_fpr"] == \
+            pytest.approx(bf.estimated_fpr(), rel=1e-6)
+
+
+def test_scrape_is_lazy_and_off_means_no_registration(monkeypatch,
+                                                      tmp_path):
+    """Telemetry off: nothing registers, nothing reads devices.
+    Telemetry on: the health callbacks run at SCRAPE time only."""
+    from attendance_tpu.obs.exposition import render
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+
+    pipe = FusedPipeline(Config(bloom_filter_capacity=1_000),
+                         client=MemoryClient(MemoryBroker()),
+                         num_banks=4)
+    assert obs.get() is None and pipe._obs is None
+
+    calls = []
+    orig = FusedPipeline.count_all
+    monkeypatch.setattr(
+        FusedPipeline, "count_all",
+        lambda self: (calls.append(1), orig(self))[1])
+    t, pipe, _ = _run_traced_fused(tmp_path, num_events=1_024,
+                                   frame=1_024)
+    assert not calls  # the whole run did no health device reads
+    render(t.registry)
+    assert calls  # ...until the scrape asked
+
+
+def test_cli_telemetry_verb_prints_trace_tree(tmp_path, capsys):
+    from attendance_tpu.cli import main
+
+    tr = _deterministic_tracer()
+    pub = tr.add_span("publish", 0.0, 0.001, trace_id=9,
+                      role="producer")
+    tr.add_span("batch", 0.001, 0.004, trace_id=9,
+                parent_id=pub.span_id, role="fused-pipeline",
+                args={"seq": 3})
+    path = tmp_path / "trace.json"
+    tr.flush(path)
+    main(["telemetry", str(path)])
+    out = capsys.readouterr().out
+    assert "trace" in out and "publish" in out and "batch" in out
+    assert "fused-pipeline" in out  # role column rides along
