@@ -334,6 +334,32 @@ def cmd_telemetry(args) -> None:
         sys.exit(2)
 
 
+def cmd_doctor(args) -> None:
+    """Offline SLO verdict over run artifacts: replay a --metrics-prom
+    exposition file, an --alert-log JSONL, a flight-recorder dump,
+    and/or a --trace-out export, print a pass/fail verdict table, and
+    exit non-zero on an SLO breach — the run's own telemetry artifacts
+    become a CI gate without rerunning anything. Exit codes: 0 = all
+    checks pass, 1 = at least one breach, 2 = unreadable artifacts."""
+    import sys
+
+    from attendance_tpu.obs.slo import doctor_report
+
+    try:
+        text, ok = doctor_report(
+            args.artifacts, fpr_ceiling=args.fpr_ceiling,
+            hll_error_ceiling=args.hll_error_ceiling)
+    except FileNotFoundError as e:
+        logger.error("no such artifact: %s", e)
+        sys.exit(2)
+    except Exception as e:
+        logger.error("unreadable artifacts: %s", e)
+        sys.exit(2)
+    print(text)
+    if not ok:
+        sys.exit(1)
+
+
 def cmd_parity(args) -> None:
     """Differential tpu-vs-oracle parity run.
 
@@ -438,6 +464,20 @@ def main(argv=None) -> None:
     p_tel.add_argument("--last", type=int, default=32,
                        help="flight records / traces shown (most recent)")
     p_tel.set_defaults(fn=cmd_telemetry)
+
+    p_doc = sub.add_parser(
+        "doctor", help="offline SLO verdict over run artifacts "
+        "(prom exposition / alert log / flight dump / trace export); "
+        "exits 1 on breach, 2 on unreadable artifacts")
+    p_doc.add_argument("artifacts", nargs="+",
+                       help="any mix of --metrics-prom, --alert-log, "
+                       "flight-recorder, and --trace-out files")
+    p_doc.add_argument("--fpr-ceiling", type=float, default=0.01,
+                       help="measured Bloom FPR ceiling (ROADMAP "
+                       "target)")
+    p_doc.add_argument("--hll-error-ceiling", type=float, default=0.02,
+                       help="measured HLL relative-error ceiling")
+    p_doc.set_defaults(fn=cmd_doctor)
 
     p_par = sub.add_parser(
         "parity", help="differential tpu-vs-oracle accuracy check "

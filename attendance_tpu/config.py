@@ -128,6 +128,30 @@ class Config:
     # JSON at end of run / teardown. Same disabled-path guarantee as
     # the metrics flags: unset = one branch per hook.
     trace_out: str = ""
+    # Continuous accuracy auditing (0.0 = disabled): keep an exact
+    # shadow (ground-truth member/cardinality sets) for this hash-
+    # sampled fraction of the key space, cross-check every sampled
+    # BF.EXISTS/PFADD/PFCOUNT answer against it, and export MEASURED
+    # accuracy gauges (attendance_bloom_measured_fpr,
+    # attendance_bloom_false_negatives_total,
+    # attendance_hll_measured_rel_error) alongside the occupancy-based
+    # estimators — obs/audit.py. Same disabled-path guarantee.
+    audit_sample: float = 0.0
+    # SLO engine ("" = disabled): evaluate declarative objectives
+    # (accuracy ceilings, throughput floor, latency quantiles) over
+    # fast+slow burn-rate windows and append one JSON line per alert
+    # transition (firing/resolved) here — obs/slo.py.
+    alert_log: str = ""
+    # Extra/override SLO specs, e.g. "fpr<=0.01", "throughput>=1e6",
+    # "dequeue_p99<=0.05" (see obs.slo.parse_slo for the full alias
+    # table). The accuracy defaults from ROADMAP's targets are always
+    # installed when the engine is on.
+    slo: List[str] = dataclasses.field(default_factory=list)
+    # Burn-rate windows (seconds): the fast window gates alert
+    # freshness and hysteresis clearing, the slow window rejects
+    # single-window spikes (SRE multi-window multi-burn-rate).
+    slo_fast_s: float = 60.0
+    slo_slow_s: float = 300.0
     # Wire format for the fused pipeline's host->device transfer.
     # Either the link or the host-side pack is the e2e bottleneck,
     # depending on the moment's link rate vs host load; "auto" starts
@@ -175,6 +199,16 @@ class Config:
             raise ValueError("metrics_interval_s must be positive")
         if self.flight_recorder < 0:
             raise ValueError("flight_recorder must be >= 0 (ring size)")
+        if not (0.0 <= self.audit_sample <= 1.0):
+            raise ValueError(
+                f"audit_sample out of range: {self.audit_sample} "
+                "(a fraction of the key space, 0 = off, 1 = audit all)")
+        if self.slo_fast_s <= 0 or self.slo_slow_s <= 0:
+            raise ValueError("SLO windows must be positive")
+        if self.slo_fast_s > self.slo_slow_s:
+            raise ValueError(
+                "slo_fast_s must not exceed slo_slow_s (the slow "
+                "window is what rejects single-window spikes)")
         if self.invalid_topic and self.invalid_topic == self.pulsar_topic:
             # Republishing invalid events onto the processor's own
             # input topic would re-consume and republish them forever.
@@ -266,6 +300,22 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
     p.add_argument("--trace-out", default=d.trace_out,
                    help="write per-batch spans as Chrome-trace/"
                    "Perfetto JSON here (empty = tracing off)")
+    p.add_argument("--audit-sample", type=float, default=d.audit_sample,
+                   help="exact-shadow accuracy audit over this hash-"
+                   "sampled fraction of the key space (0 = off); "
+                   "exports measured FPR / HLL-error gauges")
+    p.add_argument("--alert-log", default=d.alert_log,
+                   help="enable the SLO burn-rate engine and append "
+                   "one JSON line per alert transition here")
+    p.add_argument("--slo", action="append", default=None,
+                   metavar="SPEC",
+                   help="extra/override SLO spec, repeatable (e.g. "
+                   "'fpr<=0.01', 'throughput>=1e6', "
+                   "'dequeue_p99<=0.05')")
+    p.add_argument("--slo-fast-s", type=float, default=d.slo_fast_s,
+                   help="fast burn-rate window (seconds)")
+    p.add_argument("--slo-slow-s", type=float, default=d.slo_slow_s,
+                   help="slow burn-rate window (seconds)")
     return p
 
 
@@ -305,4 +355,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
         flight_recorder=args.flight_recorder,
         flight_path=args.flight_path,
         trace_out=args.trace_out,
+        audit_sample=args.audit_sample,
+        alert_log=args.alert_log,
+        slo=list(args.slo or []),
+        slo_fast_s=args.slo_fast_s,
+        slo_slow_s=args.slo_slow_s,
     ).validate()

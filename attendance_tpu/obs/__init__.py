@@ -41,6 +41,19 @@ one Tracer on the Telemetry object, same capture-once/one-branch
 discipline, flushed as Chrome-trace/Perfetto JSON at end of run and on
 teardown; trace context propagates through broker message properties
 (``traceparent``).
+
+Accuracy auditing + SLOs (obs/audit.py / obs/slo.py, ``--audit-sample``
+/ ``--alert-log`` / ``--slo``) complete the correctness pillar: a
+sampled exact shadow cross-checks live sketch answers and exports
+MEASURED gauges (``attendance_bloom_measured_fpr``,
+``attendance_bloom_false_negatives_total`` — must stay 0,
+``attendance_hll_measured_rel_error``) next to the PR 2 estimators so
+estimator drift is itself visible; the SLO engine evaluates
+declarative objectives over fast+slow burn-rate windows
+(``attendance_slo_burn_rate``/``attendance_slo_firing``), appends a
+JSONL alert log, and flags transitions in the flight ring. The
+``doctor`` CLI verb replays those artifacts offline into a
+CI-gateable verdict.
 """
 
 from __future__ import annotations
@@ -69,18 +82,37 @@ DEFAULT_FLIGHT_PATH = "flight_recorder.json"
 _atexit_installed = False
 
 
+def _atexit_flush() -> None:
+    t = TELEMETRY
+    if t is None:
+        return
+    # Order matters: classify the SLOs first (a last-moment breach must
+    # land in the alert log AND in the gauges), then write the final
+    # exposition block carrying those gauges, then the trace.
+    t.finalize_slo("atexit")
+    if t._reporter is not None:
+        try:
+            t._reporter._write_block()
+        except Exception:
+            logger.exception("atexit metrics block write failed")
+    t.flush_trace("atexit")
+
+
 def _install_atexit_flush() -> None:
-    """Register the exit-time trace flush exactly once per process; it
-    reads the CURRENT global, so stopped instances are neither pinned
-    nor flushed."""
+    """Register the exit-time telemetry flush exactly once per process;
+    it reads the CURRENT global, so stopped instances are neither
+    pinned nor flushed. Covers the trace buffer AND a final exposition
+    block: a CLI run shorter than the reporter interval would otherwise
+    exit with an EMPTY --metrics-prom file (nothing stops the daemon
+    reporter at process exit), which `doctor` would read as a missing
+    artifact."""
     global _atexit_installed
     if _atexit_installed:
         return
     _atexit_installed = True
     import atexit
 
-    atexit.register(
-        lambda: TELEMETRY is not None and TELEMETRY.flush_trace("atexit"))
+    atexit.register(_atexit_flush)
 
 
 def enabled_in(config) -> bool:
@@ -88,7 +120,10 @@ def enabled_in(config) -> bool:
     return bool(getattr(config, "metrics_prom", "")
                 or getattr(config, "metrics_port", 0)
                 or getattr(config, "flight_recorder", 0)
-                or getattr(config, "trace_out", ""))
+                or getattr(config, "trace_out", "")
+                or getattr(config, "audit_sample", 0.0)
+                or getattr(config, "alert_log", "")
+                or getattr(config, "slo", None))
 
 
 class Telemetry:
@@ -98,7 +133,9 @@ class Telemetry:
                  metrics_interval_s: float = 1.0,
                  flight_recorder: int = 0,
                  flight_path: str = DEFAULT_FLIGHT_PATH,
-                 trace_out: str = ""):
+                 trace_out: str = "", audit_sample: float = 0.0,
+                 alert_log: str = "", slo_specs=(),
+                 slo_fast_s: float = 60.0, slo_slow_s: float = 300.0):
         self.registry = Registry()
         self.flight: Optional[FlightRecorder] = (
             FlightRecorder(flight_recorder) if flight_recorder > 0
@@ -110,6 +147,24 @@ class Telemetry:
         self.tracer: Optional[Tracer] = (Tracer() if trace_out
                                          else None)
         self.trace_path = trace_out
+        # Accuracy auditor (obs/audit.py): same capture-once handle
+        # discipline — sketch stores and the fused pipeline hold
+        # `telemetry.auditor` and branch on `is not None`.
+        self.auditor = None
+        if audit_sample > 0:
+            from attendance_tpu.obs.audit import ShadowAuditor
+            self.auditor = ShadowAuditor(self.registry, audit_sample)
+        # SLO burn-rate engine (obs/slo.py): evaluates declarative
+        # objectives over the registry on its own thread; alert
+        # transitions land in the JSONL log and the flight ring.
+        self.slo = None
+        if alert_log or slo_specs:
+            from attendance_tpu.obs.slo import SloEngine
+            self.slo = SloEngine(self, slo_specs, slo_fast_s,
+                                 slo_slow_s, alert_log,
+                                 interval_s=min(metrics_interval_s,
+                                                max(slo_fast_s / 4,
+                                                    0.05)))
         self._reporter = None
         self._server = None
         self._prev_sigusr1 = _NOT_INSTALLED
@@ -139,20 +194,29 @@ class Telemetry:
         if self.flight is not None:
             self._prev_sigusr1 = install_sigusr1(self.flight,
                                                  self.flight_path)
-        if self.tracer is not None:
+        if self.slo is not None:
+            self.slo.start()
+        if (self.tracer is not None or self._reporter is not None
+                or self.slo is not None):
             # Backstop for CLI runs that never reach a run-loop flush
-            # (KeyboardInterrupt etc.); flush_trace is idempotent.
-            # ONE module-level hook flushing whatever telemetry is
-            # live at exit — per-instance registrations would pin
-            # every stopped Telemetry (and its up-to-64k-span buffer)
-            # for the process lifetime and rewrite possibly-deleted
-            # trace paths (bound-method atexit.unregister does not
-            # reliably match, so this never registers per instance).
+            # (KeyboardInterrupt, runs shorter than the reporter
+            # interval); every flush is idempotent. ONE module-level
+            # hook flushing whatever telemetry is live at exit —
+            # per-instance registrations would pin every stopped
+            # Telemetry (and its up-to-64k-span buffer) for the
+            # process lifetime and rewrite possibly-deleted artifact
+            # paths (bound-method atexit.unregister does not reliably
+            # match, so this never registers per instance).
             _install_atexit_flush()
         return self
 
     def stop(self) -> None:
         self.flush_trace("telemetry-stop")
+        if self.slo is not None:
+            # Final tick first: a firing alert must reach the log (and
+            # the flight ring) before the reporter writes its last
+            # block below.
+            self.slo.stop()
         if self._reporter is not None:
             self._reporter.stop()
             self._reporter = None
@@ -202,6 +266,16 @@ class Telemetry:
         except Exception:
             logger.exception("Flight recorder dump failed")
 
+    # -- SLO engine ----------------------------------------------------------
+    def finalize_slo(self, reason: str) -> None:
+        """End-of-run SLO evaluation (no-op without the engine): runs
+        one last classification tick so runs shorter than the tick
+        interval still judge their objectives and write any firing
+        alert before the process exits. The engine keeps running —
+        symmetric with flush_trace, which also leaves the tracer live."""
+        if self.slo is not None:
+            self.slo.finalize(reason)
+
     # -- tracing -------------------------------------------------------------
     def flush_trace(self, reason: str = "flush") -> None:
         """Write the span buffer to ``--trace-out`` (atomic; no-op
@@ -237,7 +311,12 @@ def enable(config) -> Telemetry:
             flight_recorder=getattr(config, "flight_recorder", 0),
             flight_path=getattr(config, "flight_path",
                                 DEFAULT_FLIGHT_PATH),
-            trace_out=getattr(config, "trace_out", ""))
+            trace_out=getattr(config, "trace_out", ""),
+            audit_sample=getattr(config, "audit_sample", 0.0),
+            alert_log=getattr(config, "alert_log", ""),
+            slo_specs=tuple(getattr(config, "slo", ()) or ()),
+            slo_fast_s=getattr(config, "slo_fast_s", 60.0),
+            slo_slow_s=getattr(config, "slo_slow_s", 300.0))
         t.start()
         TELEMETRY = t
         return t

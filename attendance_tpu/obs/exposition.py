@@ -222,10 +222,51 @@ def _table(rows: List[List[str]], headers: List[str]) -> str:
     return "\n".join(out)
 
 
+def _parse_le(labels: str) -> Optional[float]:
+    for part in labels.split(","):
+        if part.startswith("le="):
+            raw = part[3:].strip('"')
+            return float("inf") if raw == "+Inf" else float(raw)
+    return None
+
+
+def quantiles_from_cumulative(pairs, qs) -> List[float]:
+    """Quantile estimates from cumulative (le_bound, cum_count) bucket
+    samples, linearly interpolated inside the landing bucket (the
+    text-exposition counterpart of registry.quantile_from_buckets —
+    this one works from a scraped prom file, where only the cumulative
+    form survives). NaN per quantile when the histogram is empty; +Inf
+    when the rank lands in the +Inf bucket."""
+    pairs = sorted(pairs, key=lambda p: p[0])
+    count = pairs[-1][1] if pairs else 0.0
+    out = []
+    for q in qs:
+        if count <= 0:
+            out.append(float("nan"))
+            continue
+        rank = max(1.0, math.ceil(q * count))
+        lo_bound, lo_cum = 0.0, 0.0
+        value = float("inf")
+        for bound, cum in pairs:
+            if cum >= rank:
+                if math.isinf(bound):
+                    value = bound
+                elif cum > lo_cum:
+                    frac = (rank - lo_cum) / (cum - lo_cum)
+                    value = lo_bound + (bound - lo_bound) * frac
+                else:
+                    value = bound
+                break
+            lo_bound, lo_cum = bound, cum
+        out.append(value)
+    return out
+
+
 def format_prom_table(text: str) -> str:
     """Live-style table of the last scrape block of a prom file.
-    Histograms are folded to count/sum/mean — the raw buckets stay in
-    the file for machine consumers."""
+    Histograms are folded to count/sum/mean plus p50/p95/p99 derived
+    from the cumulative buckets (registry.Histogram.quantile's offline
+    twin) — the raw buckets stay in the file for machine consumers."""
     samples = parse_prom(text)
     hist: dict = {}
     rows = []
@@ -237,7 +278,12 @@ def format_prom_table(text: str) -> str:
                     p for p in labels.split(",") if not
                     p.startswith("le=")) if suffix == "_bucket" else labels
                 h = hist.setdefault((base, key_labels), {})
-                if suffix != "_bucket":
+                if suffix == "_bucket":
+                    le = _parse_le(labels)
+                    if le is not None:
+                        h.setdefault("_buckets", []).append(
+                            (le, float(value)))
+                else:
                     h[suffix] = value
                 break
         else:
@@ -246,8 +292,12 @@ def format_prom_table(text: str) -> str:
         count = float(h.get("_count", 0) or 0)
         total = float(h.get("_sum", 0) or 0)
         mean = f"{total / count:.6g}" if count else "n/a"
-        rows.append([base, labels,
-                     f"count={int(count)} sum={total:.6g} mean={mean}"])
+        cell = f"count={int(count)} sum={total:.6g} mean={mean}"
+        if count and h.get("_buckets"):
+            p50, p95, p99 = quantiles_from_cumulative(
+                h["_buckets"], (0.50, 0.95, 0.99))
+            cell += (f" p50={p50:.6g} p95={p95:.6g} p99={p99:.6g}")
+        rows.append([base, labels, cell])
     rows.sort()
     return _table(rows, ["metric", "labels", "value"])
 

@@ -114,6 +114,116 @@ def register_fused(telemetry, pipe, **labels) -> None:
            hll_saturated, **labels)
 
 
+def register_store(telemetry, store, bloom_key: str, **labels) -> None:
+    """Register the health gauges for a generic :class:`SketchStore`
+    (the ``--sketch-backend=memory/tpu/redis-sim`` command path, which
+    previously had NO live health surface — only the fused pipeline
+    did).
+
+    The weakref target is the STORE, not its inner filter/HLL objects:
+    snapshot restore REPLACES those innards (``_restore_filter`` /
+    ``_restore_hll_banked`` build fresh arrays), so a gauge closed over
+    an inner object would silently go stale after every restore — the
+    callbacks here re-read ``store._blooms``/``store._hll`` on each
+    scrape instead. ``utils/snapshot.restore_sketch_store``
+    additionally re-invokes this registration (idempotent:
+    ``set_function`` on the same (name, labels) gauge), so a store
+    restored under a telemetry bundle that registered against an older
+    generation resumes reporting either way."""
+    from attendance_tpu.models.hll import (
+        best_histogram as best_histogram_of,
+        estimate_from_histogram as estimate_of)
+
+    ref = weakref.ref(store)
+
+    def _fills(s):
+        """(fill, m_bits) per sub-filter of the audited bloom chain;
+        None when the key is absent or a backend handle is opaque."""
+        bloom = s._blooms.get(bloom_key)
+        if bloom is None:
+            return None
+        out = []
+        for handle, params in zip(bloom.filters, bloom.params):
+            fill = s._filter_fill(handle, params)
+            if fill is None:
+                return None
+            out.append((fill, params.m_bits))
+        return out
+
+    def fill() -> float:
+        fills = _fills(_deref(ref))
+        if not fills:
+            raise LookupError(f"no inspectable filter at {bloom_key!r}")
+        total = sum(m for _, m in fills)
+        return sum(f * m for f, m in fills) / total
+
+    def fpr() -> float:
+        v = _deref(ref).estimated_fpr(bloom_key)
+        if v is None:
+            raise LookupError(f"no inspectable filter at {bloom_key!r}")
+        return float(v)
+
+    def _regs(s) -> np.ndarray:
+        hll = getattr(s, "_hll", None)
+        if hll is not None:  # banked (tpu)
+            return np.asarray(hll.regs)
+        per_key = getattr(s, "_hll_regs", None)
+        if per_key is None:
+            per_key = getattr(s, "_hlls", None)  # redis-sim
+        if not per_key:
+            raise LookupError("store holds no HLL state yet")
+        return np.stack(list(per_key.values()))
+
+    def hll_estimate() -> float:
+        s = _deref(ref)
+        hll = getattr(s, "_hll", None)
+        if hll is not None:
+            hists = np.asarray(best_histogram_of(hll.regs, hll.precision))
+            return float(sum(
+                estimate_of(hists[b], hll.precision)
+                for b in hll._bank_of.values()))
+        # Per-key stores: sum of per-key estimates (same aggregate the
+        # fused gauge reports).
+        precision = getattr(s, "precision", 14)
+        per_key = getattr(s, "_hll_regs", None) or getattr(
+            s, "_hlls", None) or {}
+        total = 0.0
+        q = 64 - precision
+        for regs in per_key.values():
+            hist = np.bincount(np.asarray(regs), minlength=q + 2)
+            total += estimate_of(hist, precision)
+        return total
+
+    def hll_saturated() -> float:
+        s = _deref(ref)
+        precision = getattr(getattr(s, "_hll", None), "precision",
+                            getattr(s, "precision", 14))
+        return float((_regs(s) > 64 - precision).sum())
+
+    _gauge(telemetry, "attendance_bloom_fill_fraction", fill, **labels)
+    _gauge(telemetry, "attendance_bloom_estimated_fpr", fpr, **labels)
+    _gauge(telemetry, "attendance_hll_estimate", hll_estimate, **labels)
+    _gauge(telemetry, "attendance_hll_saturated_registers",
+           hll_saturated, **labels)
+    # Breadcrumb for restore-time re-registration (utils/snapshot).
+    store._health_registration = (bloom_key, dict(labels))
+
+
+def reregister_store(store) -> None:
+    """Refresh a store's health gauges after snapshot restore, if it
+    was ever registered and telemetry is still live — the literal
+    re-registration half of the restore contract (see
+    :func:`register_store`)."""
+    from attendance_tpu import obs
+
+    reg = getattr(store, "_health_registration", None)
+    t = obs.get()
+    if reg is None or t is None:
+        return
+    bloom_key, labels = reg
+    register_store(t, store, bloom_key, **labels)
+
+
 def register_bloom_filter(telemetry, bloom, **labels) -> None:
     """Register fill/FPR gauges for a standalone
     ``models.bloom.BloomFilter`` (the generic TpuSketchStore path);

@@ -27,6 +27,7 @@ re-request handles without double-counting.
 
 from __future__ import annotations
 
+import math
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -36,6 +37,36 @@ from typing import Callable, Dict, List, Optional, Tuple
 # stage latency this framework can produce (a snapshot stall measured in
 # seconds sits mid-range).
 NUM_BUCKETS = 28
+
+
+def quantile_from_buckets(buckets: List[int], count: int, q: float,
+                          scale: float = 1e6) -> float:
+    """Value at quantile ``q`` of a power-of-2 bucket snapshot, with
+    linear interpolation inside the landing bucket (bucket i spans
+    [2**(i-1), 2**i) scaled units; bucket 0 is [0, 1)).
+
+    ``count`` is the TOTAL observation count including overflow
+    (samples past the last finite bound, which the snapshot's bucket
+    list does not carry) — a rank landing there answers +Inf, the same
+    "don't claim it was below the bound" honesty as the exposition's
+    +Inf bucket. NaN when the snapshot is empty."""
+    if count <= 0:
+        return float("nan")
+    if not (0.0 <= q <= 1.0):
+        raise ValueError(f"quantile out of range: {q}")
+    # Rank of the target observation, 1-based; q=0 -> first sample.
+    rank = max(1, int(math.ceil(q * count)))
+    cum = 0
+    for i, b in enumerate(buckets):
+        if b <= 0:
+            continue
+        if cum + b >= rank:
+            lo = 0.0 if i == 0 else float(1 << (i - 1))
+            hi = float(1 << i)
+            frac = (rank - cum) / b
+            return (lo + (hi - lo) * frac) / scale
+        cum += b
+    return float("inf")  # rank falls in the overflow tail
 
 
 class Counter:
@@ -161,6 +192,16 @@ class Histogram:
     def snapshot(self) -> Tuple[List[int], float, int]:
         with self._lock:
             return list(self._buckets), self._sum, self._count
+
+    def quantile(self, q: float) -> float:
+        """p-quantile estimate from the live buckets (the "dequeue_wait
+        p99" the tracing docstring narrates — now computable): linear
+        interpolation inside the landing power-of-2 bucket, +Inf when
+        the rank falls past the last finite bound, NaN when empty. The
+        SLO engine computes WINDOWED quantiles from snapshot deltas
+        via :func:`quantile_from_buckets` directly."""
+        buckets, _, count = self.snapshot()
+        return quantile_from_buckets(buckets, count, q, self.scale)
 
     @property
     def count(self) -> int:

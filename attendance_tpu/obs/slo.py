@@ -1,0 +1,600 @@
+"""SLO burn-rate engine + the offline ``doctor`` verdict.
+
+Turns the ROADMAP's acceptance targets into runtime-evaluated SLOs
+(Beyer et al., *Site Reliability Engineering*, multi-window
+multi-burn-rate alerting): each declarative objective is sampled every
+tick, classified breach/ok, and aggregated over a FAST and a SLOW
+window. Burn rate = breaching fraction / error budget; an alert FIRES
+only when both windows burn past the firing threshold — the slow
+window rejects single-window spikes, the fast window keeps detection
+fresh — and CLEARS with hysteresis (fast burn must fall below half the
+firing threshold), so a breach oscillating around the ceiling cannot
+flap the alert.
+
+Outputs:
+
+* ``attendance_slo_burn_rate{slo=...,window=fast|slow}`` gauges and
+  ``attendance_slo_firing{slo=...}`` 0/1 on the normal scrape surface;
+* a structured JSONL alert log (``--alert-log``): one line per
+  transition (firing/resolved) with value, threshold, both burns, and
+  the most recent batch's trace id for cross-reference;
+* a flight-recorder record per transition (``alert``/``state``
+  fields), so a ring dump shows WHERE in the batch stream the SLO
+  broke.
+
+The ``doctor`` half replays run artifacts OFFLINE — a prom exposition
+file, the alert log, a flight dump, a trace export — and prints a
+pass/fail verdict table with a non-zero exit on breach: the artifacts
+a run already writes become CI-gateable without rerunning anything.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import math
+import re
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+# Classic SRE page-tier burn threshold: with a 1% error budget, firing
+# needs a sustained >=14.4% breaching fraction in BOTH windows.
+DEFAULT_BUDGET = 0.01
+DEFAULT_FIRE_BURN = 14.4
+CLEAR_RATIO = 0.5  # hysteresis: clear only below half the fire burn
+
+SLO_HELP = {
+    "attendance_slo_burn_rate":
+        "SLO burn rate (breaching fraction / error budget) per window",
+    "attendance_slo_firing":
+        "1 while the SLO's alert is firing, else 0",
+    "attendance_slo_alerts_total":
+        "Alert transitions to firing, per SLO",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Slo:
+    """One declarative objective over the live registry.
+
+    kind: ``gauge`` (max over the family's samples), ``counter``
+    (total), ``rate`` (d(counter)/dt per tick), or ``quantile``
+    (p-quantile of the tick interval's fresh histogram observations).
+    ``op`` is the HEALTHY direction: ``<=`` is a ceiling, ``>=`` a
+    floor; a tick breaches when the value violates it."""
+    name: str
+    kind: str
+    metric: str
+    op: str
+    threshold: float
+    # One (label, value) pair the metric's members must carry, e.g.
+    # ("stage", "dequeue_wait"); () matches every member.
+    label_filter: Tuple[str, ...] = ()
+    quantile: float = 0.0
+
+
+# The paper's acceptance targets (ROADMAP north star), always installed
+# when the engine is on: measured — not estimated — accuracy ceilings,
+# and the structural zero-false-negative invariant.
+DEFAULT_SLOS = (
+    Slo("bloom_measured_fpr", "gauge",
+        "attendance_bloom_measured_fpr", "<=", 0.01),
+    Slo("bloom_false_negatives", "counter",
+        "attendance_bloom_false_negatives_total", "<=", 0.0),
+    Slo("hll_measured_rel_error", "gauge",
+        "attendance_hll_measured_rel_error", "<=", 0.02),
+)
+
+_STAGE_ALIAS = {"dequeue": "dequeue_wait", "device": "device_wait",
+                "assembly": "batch_assembly"}
+_QUANTILE_RE = re.compile(r"^([a-z_]+)_p(\d{1,2})$")
+
+
+def parse_slo(spec: str) -> Slo:
+    """Parse one ``--slo`` spec: ``alias<=value`` / ``alias>=value``.
+
+    Aliases: ``fpr`` / ``false_negatives`` / ``hll_error`` (override
+    the default ceilings), ``throughput`` (events/s rate floor), and
+    ``<stage>_p<NN>`` latency-quantile ceilings over the stage
+    histograms (``dequeue_p99``, ``device_p95``, ``sketch_p50``, ...;
+    ``dequeue``/``device``/``assembly`` expand to their full stage
+    names)."""
+    for op in ("<=", ">="):
+        if op in spec:
+            alias, _, raw = spec.partition(op)
+            alias = alias.strip()
+            try:
+                threshold = float(raw)
+            except ValueError:
+                raise ValueError(f"bad SLO threshold in {spec!r}")
+            break
+    else:
+        raise ValueError(
+            f"bad SLO spec {spec!r} (want alias<=value or alias>=value)")
+    if alias == "fpr":
+        return Slo("bloom_measured_fpr", "gauge",
+                   "attendance_bloom_measured_fpr", op, threshold)
+    if alias == "false_negatives":
+        return Slo("bloom_false_negatives", "counter",
+                   "attendance_bloom_false_negatives_total", op,
+                   threshold)
+    if alias == "hll_error":
+        return Slo("hll_measured_rel_error", "gauge",
+                   "attendance_hll_measured_rel_error", op, threshold)
+    if alias == "throughput":
+        return Slo("throughput", "rate", "attendance_events_total",
+                   op, threshold)
+    m = _QUANTILE_RE.match(alias)
+    if m:
+        stage = _STAGE_ALIAS.get(m.group(1), m.group(1))
+        return Slo(alias, "quantile",
+                   "attendance_stage_latency_seconds", op, threshold,
+                   label_filter=("stage", stage),
+                   quantile=int(m.group(2)) / 100.0)
+    raise ValueError(f"unknown SLO alias {alias!r} in {spec!r}")
+
+
+def resolve_slos(specs: Sequence[str]) -> List[Slo]:
+    """Defaults + user specs; a spec naming a default REPLACES it."""
+    parsed = [parse_slo(s) for s in specs]
+    names = {s.name for s in parsed}
+    return [s for s in DEFAULT_SLOS if s.name not in names] + parsed
+
+
+class _SloState:
+    __slots__ = ("samples", "fast", "slow", "firing", "last_value",
+                 "rate_prev", "hist_prev")
+
+    def __init__(self, fast_gauge, slow_gauge):
+        self.samples: List[Tuple[float, bool]] = []
+        self.fast = fast_gauge
+        self.slow = slow_gauge
+        self.firing = False
+        self.last_value = float("nan")
+        self.rate_prev: Optional[Tuple[float, float]] = None
+        self.hist_prev = None  # (buckets, count) at the previous tick
+
+
+class SloEngine:
+    """Tick-driven evaluator. Production runs it on a background
+    thread (``start``/``stop``); tests drive :meth:`tick` directly
+    with explicit timestamps — the window math is pure function of the
+    sample times passed in."""
+
+    def __init__(self, telemetry, specs: Sequence[str] = (),
+                 fast_s: float = 60.0, slow_s: float = 300.0,
+                 path: str = "", *, budget: float = DEFAULT_BUDGET,
+                 fire_burn: float = DEFAULT_FIRE_BURN,
+                 interval_s: float = 1.0, _clock=time.monotonic):
+        self._telemetry = telemetry
+        self.slos = resolve_slos(specs)
+        self.fast_s = fast_s
+        self.slow_s = slow_s
+        self.path = path
+        self.budget = budget
+        self.fire_burn = fire_burn
+        self.interval_s = interval_s
+        self._clock = _clock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = telemetry.registry
+        self._alerts = {
+            s.name: reg.counter(
+                "attendance_slo_alerts_total",
+                help=SLO_HELP["attendance_slo_alerts_total"],
+                slo=s.name)
+            for s in self.slos}
+        self._firing_gauges = {
+            s.name: reg.gauge("attendance_slo_firing",
+                              help=SLO_HELP["attendance_slo_firing"],
+                              slo=s.name)
+            for s in self.slos}
+        self._state: Dict[str, _SloState] = {
+            s.name: _SloState(
+                reg.gauge("attendance_slo_burn_rate",
+                          help=SLO_HELP["attendance_slo_burn_rate"],
+                          slo=s.name, window="fast"),
+                reg.gauge("attendance_slo_burn_rate",
+                          help=SLO_HELP["attendance_slo_burn_rate"],
+                          slo=s.name, window="slow"))
+            for s in self.slos}
+        for g in self._firing_gauges.values():
+            g.set(0.0)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "SloEngine":
+        if self.path:
+            # Touch the log so a clean run still leaves the artifact
+            # (doctor reads an empty file as "0 transitions" — a
+            # MISSING file would be indistinguishable from a run that
+            # never had the engine on).
+            try:
+                Path(self.path).parent.mkdir(parents=True,
+                                             exist_ok=True)
+                Path(self.path).touch()
+            except Exception:
+                logger.exception("alert log touch failed")
+        self._thread = threading.Thread(target=self._loop,
+                                        name="slo-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                logger.exception("SLO tick failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.finalize("engine-stop")
+
+    def finalize(self, reason: str) -> None:
+        """One last evaluation so short runs (shorter than a tick
+        interval) still classify and any firing alert reaches the log
+        before process exit."""
+        try:
+            self.tick()
+        except Exception:
+            logger.exception("SLO final tick failed (%s)", reason)
+
+    # -- value extraction ----------------------------------------------------
+    def _family(self, metric: str):
+        for name, kind, help, members in (
+                self._telemetry.registry.collect()):
+            if name == metric:
+                return members
+        return []
+
+    def _members(self, slo: Slo):
+        members = self._family(slo.metric)
+        if slo.label_filter:
+            members = [m for m in members
+                       if slo.label_filter in m.labels]
+        return members
+
+    def _value(self, slo: Slo, now: float, st: _SloState) -> float:
+        members = self._members(slo)
+        if slo.kind == "gauge":
+            vals = []
+            for m in members:
+                try:
+                    v = float(m.read())
+                except Exception:
+                    continue  # a dead callback is "no signal", not 0.0
+                if not math.isnan(v):
+                    vals.append(v)
+            return max(vals) if vals else float("nan")
+        if slo.kind == "counter":
+            return float(sum(m.value for m in members)) \
+                if members else float("nan")
+        if slo.kind == "rate":
+            total = float(sum(m.value for m in members)) \
+                if members else 0.0
+            prev = st.rate_prev
+            st.rate_prev = (now, total)
+            if prev is None or now <= prev[0]:
+                return float("nan")
+            return (total - prev[1]) / (now - prev[0])
+        if slo.kind == "quantile":
+            from attendance_tpu.obs.registry import (
+                quantile_from_buckets)
+            if not members:
+                return float("nan")
+            h = members[0]
+            buckets, _, count = h.snapshot()
+            prev = st.hist_prev
+            st.hist_prev = (buckets, count)
+            if prev is None:
+                return float("nan")
+            db = [b - p for b, p in zip(buckets, prev[0])]
+            dc = count - prev[1]
+            if dc <= 0:
+                return float("nan")  # no fresh observations this tick
+            return quantile_from_buckets(db, dc, slo.quantile, h.scale)
+        raise ValueError(f"unknown SLO kind {slo.kind!r}")
+
+    @staticmethod
+    def _breaches(slo: Slo, value: float) -> bool:
+        if math.isnan(value):
+            return False  # no signal is not a breach
+        if slo.op == "<=":
+            return value > slo.threshold
+        return value < slo.threshold
+
+    # -- window math ---------------------------------------------------------
+    def _burn(self, samples: List[Tuple[float, bool]], now: float,
+              window_s: float) -> float:
+        """Breaching fraction over the window / error budget. The
+        denominator is the window's EXPECTED sample count (window /
+        tick interval), not just the samples seen so far: dividing by
+        a near-empty window would let the very first breaching tick
+        claim a 100%-breach window and fire instantly — exactly the
+        single-tick spike the slow window exists to reject. Until a
+        window has filled once, missing ticks count as healthy."""
+        inside = [b for t, b in samples if t > now - window_s]
+        if not inside:
+            return 0.0
+        expected = max(1, math.ceil(window_s / self.interval_s))
+        return (sum(inside) / max(len(inside), expected)) / self.budget
+
+    def tick(self, now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        with self._lock:
+            for slo in self.slos:
+                st = self._state[slo.name]
+                value = self._value(slo, now, st)
+                st.last_value = value
+                st.samples.append((now, self._breaches(slo, value)))
+                cutoff = now - self.slow_s
+                while st.samples and st.samples[0][0] <= cutoff:
+                    st.samples.pop(0)
+                burn_fast = self._burn(st.samples, now, self.fast_s)
+                burn_slow = self._burn(st.samples, now, self.slow_s)
+                st.fast.set(burn_fast)
+                st.slow.set(burn_slow)
+                if (not st.firing and burn_fast >= self.fire_burn
+                        and burn_slow >= self.fire_burn):
+                    st.firing = True
+                    self._alerts[slo.name].inc()
+                    self._firing_gauges[slo.name].set(1.0)
+                    self._emit(slo, st, "firing", burn_fast, burn_slow)
+                elif (st.firing
+                      and burn_fast < self.fire_burn * CLEAR_RATIO):
+                    st.firing = False
+                    self._firing_gauges[slo.name].set(0.0)
+                    self._emit(slo, st, "resolved", burn_fast,
+                               burn_slow)
+
+    # -- alert emission ------------------------------------------------------
+    def _last_trace(self) -> str:
+        """Trace id of the most recent flight-recorder batch record —
+        the cross-reference from an SLO transition into the span tree
+        (empty when no recorder/tracing is live)."""
+        flight = getattr(self._telemetry, "flight", None)
+        if flight is None:
+            return ""
+        records = flight.snapshot()
+        for rec in reversed(records):
+            t = rec.get("trace") if isinstance(rec, dict) else None
+            if t:
+                return str(t)
+        return ""
+
+    def _emit(self, slo: Slo, st: _SloState, state: str,
+              burn_fast: float, burn_slow: float) -> None:
+        trace = self._last_trace()
+        value = st.last_value
+        event = {
+            "ts": round(time.time(), 3),
+            "slo": slo.name,
+            "state": state,
+            "metric": slo.metric,
+            "op": slo.op,
+            "threshold": slo.threshold,
+            "value": None if math.isnan(value) else round(value, 6),
+            "burn_fast": round(burn_fast, 3),
+            "burn_slow": round(burn_slow, 3),
+            "window_fast_s": self.fast_s,
+            "window_slow_s": self.slow_s,
+        }
+        if trace:
+            event["trace"] = trace
+        logger.warning("SLO %s %s (value=%s threshold=%s%s burn "
+                       "fast=%.1f slow=%.1f)", slo.name, state.upper(),
+                       event["value"], slo.op, slo.threshold,
+                       burn_fast, burn_slow)
+        if self.path:
+            try:
+                with open(self.path, "a") as f:
+                    f.write(json.dumps(event) + "\n")
+            except Exception:
+                logger.exception("alert log append failed")
+        # Flag the transition in the flight ring: a dump then shows the
+        # alert inline with the batch records around it, trace id
+        # attached for the jump into the Perfetto tree.
+        rec = {"ts": event["ts"], "alert": slo.name, "state": state}
+        if trace:
+            rec["trace"] = trace
+        self._telemetry.record_batch(**rec)
+
+
+# ---------------------------------------------------------------------------
+# doctor: offline artifact replay -> verdict table + exit code
+# ---------------------------------------------------------------------------
+
+def _classify(path: str) -> Tuple[str, object]:
+    """Sniff one artifact: ('prom', text) | ('alerts', [events]) |
+    ('flight', doc) | ('trace', doc)."""
+    text = Path(path).read_text()
+    stripped = text.lstrip()
+    if not stripped:
+        # An empty file is a clean run's alert log (the engine touches
+        # it at start so "no transitions" and "engine never ran" stay
+        # distinguishable artifacts).
+        return "alerts", []
+    if not stripped.startswith("{"):
+        return "prom", text
+    try:
+        doc = json.loads(text)
+        if "traceEvents" in doc:
+            return "trace", doc
+        if "slo" in doc and "state" in doc:
+            # A one-transition alert log is a single valid JSON object
+            # — the event signature, not the document shape, decides.
+            return "alerts", [doc]
+        return "flight", doc
+    except json.JSONDecodeError:
+        events = []
+        for line in stripped.splitlines():
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+        if not all(isinstance(e, dict) and "slo" in e for e in events):
+            raise ValueError(f"unrecognized artifact {path!r}")
+        return "alerts", events
+
+
+def _fmt_value(v: Optional[float]) -> str:
+    if v is None:
+        return "n/a"
+    if math.isnan(v):
+        return "NaN"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.6g}"
+
+
+def _prom_checks(text: str, fpr_ceiling: float,
+                 hll_error_ceiling: float,
+                 fire_burn: float) -> List[List[str]]:
+    from attendance_tpu.obs.exposition import parse_prom
+
+    samples = parse_prom(text)
+
+    def _vals(metric: str, label_part: str = "") -> List[float]:
+        out = []
+        for name, labels, value in samples:
+            if name == metric and label_part in labels:
+                try:
+                    v = float(value)
+                except ValueError:
+                    continue
+                if not math.isnan(v):
+                    out.append(v)
+        return out
+
+    rows: List[List[str]] = []
+
+    def ceiling(check: str, metric: str, limit: float) -> None:
+        vals = _vals(metric)
+        if not vals:
+            rows.append([check, "n/a", f"<= {_fmt_value(limit)}",
+                         "n/a"])
+            return
+        worst = max(vals)
+        rows.append([check, _fmt_value(worst),
+                     f"<= {_fmt_value(limit)}",
+                     "PASS" if worst <= limit else "FAIL"])
+
+    ceiling("bloom measured FPR", "attendance_bloom_measured_fpr",
+            fpr_ceiling)
+    fn = _vals("attendance_bloom_false_negatives_total")
+    rows.append(["bloom false negatives",
+                 _fmt_value(max(fn) if fn else None), "== 0",
+                 "n/a" if not fn
+                 else ("PASS" if max(fn) == 0 else "FAIL")])
+    ceiling("HLL measured rel error",
+            "attendance_hll_measured_rel_error", hll_error_ceiling)
+    # Estimator drift: measurement vs the fill^k model, informational
+    # (a large drift means the estimator is lying, not that the run
+    # breached — the measured ceiling above is the gate).
+    measured = _vals("attendance_bloom_measured_fpr")
+    estimated = _vals("attendance_bloom_estimated_fpr")
+    if measured and estimated:
+        drift = abs(max(measured) - max(estimated))
+        rows.append(["FPR estimator drift", _fmt_value(drift), "-",
+                     "info"])
+    firing = [(labels, v) for name, labels, v in samples
+              if name == "attendance_slo_firing" and float(v) >= 1.0]
+    rows.append(["SLO alerts firing at last scrape", str(len(firing)),
+                 "== 0", "PASS" if not firing else "FAIL"])
+    burns = _vals("attendance_slo_burn_rate", 'window="slow"')
+    if burns:
+        worst = max(burns)
+        rows.append(["worst slow-window burn rate", _fmt_value(worst),
+                     f"< {_fmt_value(fire_burn)}",
+                     "PASS" if worst < fire_burn else "FAIL"])
+    return rows
+
+
+def _alert_checks(events: List[dict]) -> Tuple[List[List[str]],
+                                               List[str]]:
+    last_state: Dict[str, str] = {}
+    fired: Dict[str, int] = {}
+    traces: List[str] = []
+    for e in events:
+        last_state[e["slo"]] = e.get("state", "")
+        if e.get("state") == "firing":
+            fired[e["slo"]] = fired.get(e["slo"], 0) + 1
+            if e.get("trace"):
+                traces.append(str(e["trace"]))
+    rows: List[List[str]] = []
+    if not events:
+        rows.append(["alert log", "0 transitions", "-", "PASS"])
+    for slo in sorted(last_state):
+        unresolved = last_state[slo] == "firing"
+        rows.append([f"alert {slo}",
+                     f"{fired.get(slo, 0)} fired, last "
+                     f"{last_state[slo]}", "resolved",
+                     "FAIL" if unresolved else "PASS"])
+    return rows, traces
+
+
+def doctor_report(paths: Sequence[str], *,
+                  fpr_ceiling: float = 0.01,
+                  hll_error_ceiling: float = 0.02,
+                  fire_burn: float = DEFAULT_FIRE_BURN
+                  ) -> Tuple[str, bool]:
+    """Replay run artifacts offline; returns (verdict text, ok).
+
+    Accepts any mix of: a ``--metrics-prom`` exposition file (the last
+    scrape block is judged), a ``--alert-log`` JSONL, a flight-recorder
+    dump, a ``--trace-out`` export. Unknown/unreadable files raise —
+    the CLI maps that to exit 2, distinct from the SLO-breach exit 1.
+    """
+    from attendance_tpu.obs.exposition import _table
+
+    rows: List[List[str]] = []
+    artifacts: List[str] = []
+    alert_traces: List[str] = []
+    trace_ids: set = set()
+    flight_alerts = 0
+    for path in paths:
+        kind, payload = _classify(path)
+        artifacts.append(f"{kind}: {Path(path).name}")
+        if kind == "prom":
+            rows.extend(_prom_checks(payload, fpr_ceiling,
+                                     hll_error_ceiling, fire_burn))
+        elif kind == "alerts":
+            arows, traces = _alert_checks(payload)
+            rows.extend(arows)
+            alert_traces.extend(traces)
+        elif kind == "flight":
+            recs = payload.get("records", [])
+            flight_alerts += sum(1 for r in recs
+                                 if isinstance(r, dict) and "alert" in r)
+            trace_ids.update(str(r["trace"]) for r in recs
+                             if isinstance(r, dict) and r.get("trace"))
+        elif kind == "trace":
+            for e in payload.get("traceEvents", []):
+                t = (e.get("args") or {}).get("trace_id")
+                if t:
+                    trace_ids.add(str(t))
+    if flight_alerts:
+        rows.append(["flight records flagged by alerts",
+                     str(flight_alerts), "-", "info"])
+    if alert_traces:
+        found = sum(1 for t in alert_traces if t in trace_ids)
+        rows.append(["alert trace ids found in trace/flight artifacts",
+                     f"{found}/{len(alert_traces)}", "-", "info"])
+    if not rows:
+        raise ValueError("no judgeable artifacts (need a prom "
+                         "exposition file or an alert log)")
+    ok = not any(r[3] == "FAIL" for r in rows)
+    failed = sum(1 for r in rows if r[3] == "FAIL")
+    head = [f"doctor: {len(artifacts)} artifact(s) — "
+            + ", ".join(artifacts),
+            _table(rows, ["check", "value", "target", "verdict"]),
+            f"verdict: {'PASS' if ok else f'FAIL ({failed} breached)'}"]
+    return "\n".join(head), ok

@@ -267,12 +267,22 @@ class FusedPipeline:
         self._snap_copy = None
         if self._snap_dir is not None:
             self.restore()
+        # Accuracy auditor (obs/audit.py): the hot loop only RECORDS
+        # sampled shadow truth (one vectorized hash + a small set
+        # update per frame); the measured gauges are scrape-time
+        # callbacks that re-query the live filter — one branch per
+        # frame when auditing is off.
+        self._auditor = (self._obs.auditor if self._obs is not None
+                         else None)
         if self._obs is not None:
             # Sketch-health gauges: lazy callbacks — device reads
             # (fill popcount, register histograms) happen only when a
             # scrape renders the registry, never on the hot path.
             from attendance_tpu.obs import health
             health.register_fused(self._obs, self)
+            if self._auditor is not None:
+                from attendance_tpu.obs.audit import register_fused_audit
+                register_fused_audit(self._obs, self)
 
     _LUT_SIZE = 1 << 14  # covers ~44 years of calendar days from base
     _TRACE_ROLE = "fused-pipeline"
@@ -281,6 +291,12 @@ class FusedPipeline:
     def preload(self, keys) -> None:
         keys = np.asarray(keys, dtype=np.uint32)
         self._bloom_host = None  # invalidate the snapshot-path cache
+        if self._auditor is not None:
+            # The roster IS the filter's full membership (the hot loop
+            # never BF.ADDs): its sampled subset is the shadow's
+            # ground truth for both the false-negative probe and the
+            # measured-FPR negative classification.
+            self._auditor.record_roster(keys)
         if self.sharded:
             self.engine.preload(keys)
             return
@@ -390,6 +406,12 @@ class FusedPipeline:
         n = len(cols["student_id"])
         if n == 0:
             return None
+        if self._auditor is not None:
+            # Shadow recording only — no device read, no sync; the
+            # sampled ~1% of lanes feed the scrape-time measured
+            # FPR / HLL-error callbacks (obs/audit.register_fused_audit).
+            self._auditor.observe_fused_frame(cols["student_id"],
+                                              cols["lecture_day"])
         if self.sharded:
             sid = cols["student_id"]
             banks = self._banks_for(cols["lecture_day"])
@@ -1309,6 +1331,10 @@ class FusedPipeline:
             self.metrics.write_json_line(self.config.metrics_json,
                                          fpr_is_lower_bound=True)
         if self._obs is not None:
+            # One last SLO classification before the trace flush: a
+            # run shorter than the engine's tick interval must still
+            # judge its objectives (and log any firing alert).
+            self._obs.finalize_slo("run-end")
             self._obs.flush_trace("run-end")
 
     def _begin_batch_span(self, msg, t_rx: float, t_got: float):

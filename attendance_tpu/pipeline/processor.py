@@ -524,6 +524,9 @@ class AttendanceProcessor:
                     estimated_fpr=self.estimated_fpr(),
                     fpr_is_lower_bound=blocked)
             if self._obs is not None:
+                # Judge the SLOs once more before the trace flush so a
+                # short run still classifies (and logs) its breaches.
+                self._obs.finalize_slo("run-end")
                 self._obs.flush_trace("run-end")
 
     def estimated_fpr(self) -> Optional[float]:

@@ -24,15 +24,32 @@ from attendance_tpu.sketch.tpu_store import TpuSketchStore  # noqa: F401
 
 
 def make_sketch_store(config) -> SketchStore:
-    """Build the sketch store selected by config.sketch_backend."""
+    """Build the sketch store selected by config.sketch_backend.
+
+    When live telemetry is on, the inspectable backends (everything
+    but the real Redis server, whose filter state lives remotely) also
+    register the sketch-health gauges — the same fill/FPR/estimate
+    surface the fused pipeline has had since PR 2, now on the generic
+    command path too (obs/health.register_store; weakref'd, device
+    reads only at scrape time, refreshed on snapshot restore)."""
     if config.sketch_backend == "tpu":
-        return TpuSketchStore(config)
-    if config.sketch_backend == "memory":
-        return MemorySketchStore(config)
-    if config.sketch_backend == "redis":
+        store = TpuSketchStore(config)
+    elif config.sketch_backend == "memory":
+        store = MemorySketchStore(config)
+    elif config.sketch_backend == "redis":
         from attendance_tpu.sketch.redis_store import RedisSketchStore
-        return RedisSketchStore(config)
-    if config.sketch_backend == "redis-sim":
+        return RedisSketchStore(config)  # no inspectable local state
+    elif config.sketch_backend == "redis-sim":
         from attendance_tpu.sketch.redis_sim import RedisSimSketchStore
-        return RedisSimSketchStore(config)
-    raise ValueError(f"unknown sketch backend {config.sketch_backend!r}")
+        store = RedisSimSketchStore(config)
+    else:
+        raise ValueError(
+            f"unknown sketch backend {config.sketch_backend!r}")
+    from attendance_tpu import obs
+    t = obs.ensure(config)
+    if t is not None:
+        from attendance_tpu.obs import health
+        health.register_store(
+            t, store, getattr(config, "bloom_filter_key", "bf"),
+            backend=config.sketch_backend)
+    return store

@@ -153,6 +153,17 @@ class SketchStore(abc.ABC):
     def __init__(self, config):
         self.config = config
         self._blooms: Dict[str, ScalableBloom] = {}
+        # Accuracy auditor (obs/audit.py): captured ONCE here, one
+        # `is not None` branch per public command when auditing is off
+        # — the utils/profiling.py discipline. The hooks live on the
+        # PUBLIC command surface (not the _filter_*/_hll_* primitives),
+        # so internal membership probes (ScalableBloom.add_many's
+        # dedup contains) never pollute the measured-FPR denominator,
+        # and every backend that routes through this dispatch
+        # (memory / tpu / redis-sim) is audited identically.
+        from attendance_tpu import obs
+        t = obs.ensure(config) if config is not None else None
+        self._auditor = t.auditor if t is not None else None
 
     # -- backend primitives -------------------------------------------------
     @abc.abstractmethod
@@ -200,11 +211,27 @@ class SketchStore(abc.ABC):
         return bloom
 
     def bf_add_many(self, key: str, members) -> np.ndarray:
-        return self._bloom_or_create(key).add_many(members_to_u32(members))
+        u32 = members_to_u32(members)
+        out = self._bf_add_u32(key, u32)
+        if self._auditor is not None:
+            self._auditor.record_bf_add(key, u32)
+        return out
 
     def bf_exists_many(self, key: str, members) -> np.ndarray:
-        bloom = self._blooms.get(key)
         u32 = members_to_u32(members)
+        out = self._bf_exists_u32(key, u32)
+        if self._auditor is not None:
+            self._auditor.check_bf_exists(key, u32, out)
+        return out
+
+    # Backend chokepoints under the audited surface: subclasses that
+    # reimplement the command semantics wholesale (redis_sim) override
+    # THESE, so the audit cross-check above still sees their answers.
+    def _bf_add_u32(self, key: str, u32: np.ndarray) -> np.ndarray:
+        return self._bloom_or_create(key).add_many(u32)
+
+    def _bf_exists_u32(self, key: str, u32: np.ndarray) -> np.ndarray:
+        bloom = self._blooms.get(key)
         if bloom is None:
             return np.zeros(len(u32), dtype=bool)
         return bloom.contains_many(u32)
@@ -212,16 +239,39 @@ class SketchStore(abc.ABC):
     # -- HLL command surface ------------------------------------------------
     def pfadd(self, key: str, *members) -> int:
         if not members:
-            return 0
-        return self._hll_add(key, members_to_u32(members))
+            return self._pf_create(key)
+        u32 = members_to_u32(members)
+        out = self._pfadd_u32(key, u32, None, True)
+        if self._auditor is not None:
+            self._auditor.record_pfadd(key, u32)
+        return out
 
     def pfadd_many(self, key: str, members,
                    mask: Optional[np.ndarray] = None,
                    want_changed: bool = False) -> int:
-        return self._hll_add(key, members_to_u32(members), mask,
-                             want_changed)
+        u32 = members_to_u32(members)
+        out = self._pfadd_u32(key, u32, mask, want_changed)
+        if self._auditor is not None:
+            self._auditor.record_pfadd(key, u32, mask)
+        return out
 
     def pfcount(self, *keys: str) -> int:
+        out = self._pfcount_keys(keys)
+        if self._auditor is not None:
+            self._auditor.check_pfcount(keys, out)
+        return out
+
+    def _pf_create(self, key: str) -> int:
+        """PFADD with no members (create-only form); the generic
+        backends treat it as a no-op returning 0."""
+        return 0
+
+    def _pfadd_u32(self, key: str, u32: np.ndarray,
+                   mask: Optional[np.ndarray],
+                   want_changed: bool) -> int:
+        return self._hll_add(key, u32, mask, want_changed)
+
+    def _pfcount_keys(self, keys: Sequence[str]) -> int:
         return self._hll_count(keys)
 
     # -- observability ------------------------------------------------------

@@ -54,7 +54,7 @@ import numpy as np
 from attendance_tpu.models.hll import estimate_from_histogram
 from attendance_tpu.sketch.base import (
     DEFAULT_CAPACITY, DEFAULT_ERROR_RATE, EXPANSION, ResponseError,
-    SketchStore, members_to_u32)
+    SketchStore)
 
 # ---------------------------------------------------------------------------
 # MurmurHash64A, vectorized over same-length byte strings.
@@ -386,12 +386,15 @@ class RedisSimSketchStore(SketchStore):
             self._blooms[key] = chain
         return chain
 
-    def bf_add_many(self, key: str, members) -> np.ndarray:
-        return self._chain_or_create(key).add_many(members_to_u32(members))
+    # Overrides land on the _u32 chokepoints (not the public methods)
+    # so the base class's audit cross-check still sees every simulated
+    # answer — the shadow auditor judges Redis's algorithms with the
+    # same harness as the tpu/memory backends.
+    def _bf_add_u32(self, key: str, u32: np.ndarray) -> np.ndarray:
+        return self._chain_or_create(key).add_many(u32)
 
-    def bf_exists_many(self, key: str, members) -> np.ndarray:
+    def _bf_exists_u32(self, key: str, u32: np.ndarray) -> np.ndarray:
         chain = self._blooms.get(key)
-        u32 = members_to_u32(members)
         if chain is None:
             return np.zeros(len(u32), dtype=bool)
         return chain.contains_many(u32)
@@ -403,20 +406,16 @@ class RedisSimSketchStore(SketchStore):
             regs = self._hlls[key] = np.zeros(_HLL_REGISTERS, dtype=np.uint8)
         return regs
 
-    def pfadd(self, key: str, *members) -> int:
-        if not members:
-            # Redis: PFADD with no members creates the key; returns
-            # 1 iff it did not exist.
-            existed = key in self._hlls
-            self._regs_of(key)
-            return int(not existed)
-        return self.pfadd_many(key, members_to_u32(members),
-                               want_changed=True)
+    def _pf_create(self, key: str) -> int:
+        # Redis: PFADD with no members creates the key; returns 1 iff
+        # it did not exist.
+        existed = key in self._hlls
+        self._regs_of(key)
+        return int(not existed)
 
-    def pfadd_many(self, key: str, members,
-                   mask: Optional[np.ndarray] = None,
-                   want_changed: bool = False) -> int:
-        u32 = members_to_u32(members)
+    def _pfadd_u32(self, key: str, u32: np.ndarray,
+                   mask: Optional[np.ndarray],
+                   want_changed: bool) -> int:
         if mask is not None:
             u32 = u32[np.asarray(mask, dtype=bool)]
         regs = self._regs_of(key)
@@ -427,7 +426,7 @@ class RedisSimSketchStore(SketchStore):
         np.maximum.at(regs, idx, rank.astype(np.uint8))
         return int(changed)
 
-    def pfcount(self, *keys: str) -> int:
+    def _pfcount_keys(self, keys) -> int:
         known = [self._hlls[k] for k in keys if k in self._hlls]
         if not known:
             return 0

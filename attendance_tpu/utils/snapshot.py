@@ -104,3 +104,12 @@ def restore_sketch_store(store, path) -> None:
             regs = {key: data[f"hll/{i}"]
                     for i, key in enumerate(hinfo["keys"])}
             store._restore_hll_per_key(regs, hinfo["precision"])
+
+    # Restore REPLACES the store's filter handles and HLL registers —
+    # any weakref'd health gauge registered against the previous
+    # generation's inner objects would silently go stale (its callback
+    # raising forever, every scrape skipping the sample). Re-register
+    # so the restored store resumes reporting (no-op when the store
+    # was never registered or telemetry is down).
+    from attendance_tpu.obs.health import reregister_store
+    reregister_store(store)

@@ -403,17 +403,18 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
                        num_banks: int) -> dict:
     """Telemetry-overhead guardrail for the fused e2e path.
 
-    Three converged e2e measurements in one process: telemetry
+    Four converged e2e measurements in one process: telemetry
     DISABLED (the shipped default — every obs hook short-circuits on
     one branch), METRICS-ONLY enabled in-memory (registry + flight
     ring live; no reporter/server I/O, isolating hook cost from scrape
-    cost), and METRICS+TRACING (the span tracer live on top — per-
-    batch span allocation, context parse, buffer append). The report
-    carries the per-feature deltas; ``guardrail_pass`` asserts the
-    FULLY enabled run holds the <= 2% budget — strictly harder than
-    the disabled-path requirement the telemetry design makes
-    structural (a hook that records nothing cannot cost more than one
-    that does).
+    cost), METRICS+TRACING (the span tracer live on top — per-batch
+    span allocation, context parse, buffer append), and
+    METRICS+TRACING+AUDIT (the shadow auditor recording at the default
+    1% sample on top of everything). The report carries the
+    per-feature deltas; ``guardrail_pass`` asserts the FULLY enabled
+    run holds the <= 2% budget — strictly harder than the
+    disabled-path requirement the telemetry design makes structural (a
+    hook that records nothing cannot cost more than one that does).
     """
     import tempfile
 
@@ -435,27 +436,42 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
             traced = bench_e2e(batch_size, seconds, capacity, num_banks)
         finally:
             obs.disable()
+    with tempfile.TemporaryDirectory() as tdir:
+        obs.enable(Config(flight_recorder=256,
+                          trace_out=os.path.join(tdir, "trace.json"),
+                          audit_sample=0.01))
+        try:
+            audited = bench_e2e(batch_size, seconds, capacity,
+                                num_banks)
+        finally:
+            obs.disable()
 
     base = max(disabled["events_per_sec"], 1e-9)
     metrics_frac = 1.0 - metrics_only["events_per_sec"] / base
     traced_frac = 1.0 - traced["events_per_sec"] / base
+    audited_frac = 1.0 - audited["events_per_sec"] / base
     return {
         "disabled_events_per_sec": round(disabled["events_per_sec"], 1),
         "enabled_events_per_sec": round(
             metrics_only["events_per_sec"], 1),
         "traced_events_per_sec": round(traced["events_per_sec"], 1),
+        "audited_events_per_sec": round(audited["events_per_sec"], 1),
         # Per-feature attribution: what metrics alone cost, what the
-        # span tracer added on top, and the combined number the <= 2%
-        # guardrail is defined over.
+        # span tracer added, what the 1%-sample shadow audit added on
+        # top of both, and the combined number the <= 2% guardrail is
+        # defined over.
         "metrics_overhead_frac": round(metrics_frac, 4),
         "tracing_overhead_frac": round(traced_frac - metrics_frac, 4),
-        "overhead_frac": round(traced_frac, 4),
-        "guardrail_pass": traced_frac <= 0.02,
+        "audit_overhead_frac": round(audited_frac - traced_frac, 4),
+        "overhead_frac": round(audited_frac, 4),
+        "audit_sample": 0.01,
+        "guardrail_pass": audited_frac <= 0.02,
         "disabled_rates": disabled["rates"],
         "enabled_rates": metrics_only["rates"],
         "traced_rates": traced["rates"],
+        "audited_rates": audited["rates"],
         "converged": (disabled["converged"] and metrics_only["converged"]
-                      and traced["converged"]),
+                      and traced["converged"] and audited["converged"]),
         "wire": disabled["wire"],
         "device": disabled["device"],
     }
@@ -1310,10 +1326,12 @@ def main() -> None:
                     r["disabled_events_per_sec"]), 4),
                 **{k: r[k] for k in
                    ("disabled_events_per_sec", "enabled_events_per_sec",
-                    "traced_events_per_sec", "metrics_overhead_frac",
-                    "tracing_overhead_frac",
+                    "traced_events_per_sec", "audited_events_per_sec",
+                    "metrics_overhead_frac", "tracing_overhead_frac",
+                    "audit_overhead_frac", "audit_sample",
                     "guardrail_pass", "disabled_rates", "enabled_rates",
-                    "traced_rates", "converged", "wire", "device")},
+                    "traced_rates", "audited_rates", "converged",
+                    "wire", "device")},
             }
         elif args.mode == "probe":
             # Helper half of _probe_link_rate (own process: the raw

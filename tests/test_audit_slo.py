@@ -1,0 +1,557 @@
+"""Accuracy auditing + SLO engine + doctor verdict (obs/audit, obs/slo).
+
+Covers: hash-partition sampling, measured-FPR/false-negative/HLL-error
+cross-checks against an exact offline recount (store path and fused
+path), the burn-rate window math (fires on sustained breach, rejects a
+single-window spike, clears with hysteresis), the alert log + flight
+cross-reference, Histogram.quantile and its exposition twin, health
+gauges surviving snapshot restore (restore-then-scrape), and the
+``doctor`` verdict table golden file with its exit-code contract.
+"""
+
+import json
+import math
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from attendance_tpu import obs
+from attendance_tpu.config import Config
+from attendance_tpu.obs.audit import ShadowAuditor
+from attendance_tpu.obs.registry import Registry, quantile_from_buckets
+from attendance_tpu.obs.slo import (
+    SloEngine, doctor_report, parse_slo, resolve_slos)
+from attendance_tpu.sketch import make_sketch_store
+
+GOLDEN = Path(__file__).parent / "data" / "doctor_verdict.golden"
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    obs.disable()
+    yield
+    obs.disable()
+
+
+# -- sampling ----------------------------------------------------------------
+
+def test_sample_mask_is_a_hash_partition():
+    """Sequential keys (the reference's roster shape) sample at ~the
+    requested fraction, and the mask is a pure function of the key —
+    the same key is sampled on add and on query."""
+    reg = Registry()
+    aud = ShadowAuditor(reg, 0.1)
+    keys = np.arange(100_000, dtype=np.uint32)
+    mask = aud.sample_mask(keys)
+    assert 0.08 < mask.mean() < 0.12
+    np.testing.assert_array_equal(mask, aud.sample_mask(keys))
+
+
+# -- store-path auditing -----------------------------------------------------
+
+def _audited_store(sample: float):
+    cfg = Config(sketch_backend="memory", audit_sample=sample,
+                 bloom_filter_capacity=2_000)
+    t = obs.enable(cfg)
+    return t, cfg, make_sketch_store(cfg)
+
+
+def test_measured_fpr_agrees_with_exact_offline_recount():
+    """The acceptance scenario at store level: the measured-FPR gauge
+    must equal an independent recount over the sampled keys — sampled
+    negative queries classified by true roster membership, false
+    positives by the store's own answers."""
+    t, cfg, store = _audited_store(0.25)
+    roster = np.arange(1_000, dtype=np.int64)
+    store.bf_add_many(cfg.bloom_filter_key, roster)
+    queries = np.arange(500, 3_000, dtype=np.int64)
+    answers = np.asarray(
+        store.bf_exists_many(cfg.bloom_filter_key, queries))
+
+    aud = t.auditor
+    mask = aud.sample_mask(queries.astype(np.uint32))
+    in_roster = queries < 1_000  # exact membership, by construction
+    negatives = int((mask & ~in_roster).sum())
+    fps = int((mask & ~in_roster & answers).sum())
+    assert negatives > 0
+    assert aud._negatives.value == negatives
+    assert aud._fp.value == fps
+    assert aud.measured_fpr() == pytest.approx(fps / negatives)
+    # Structural invariant: an added key can never answer absent.
+    assert aud._fn.value == 0
+    text = t.render()
+    assert "attendance_bloom_measured_fpr" in text
+    assert "attendance_bloom_false_negatives_total 0" in text
+
+
+def test_hll_measured_rel_error_agrees_with_exact_recount():
+    """At sample=1.0 the shadow is the full ground truth, so the gauge
+    must equal |PFCOUNT - exact|/exact to float precision."""
+    t, cfg, store = _audited_store(1.0)
+    key = f"{cfg.hll_key_prefix}LECTURE_1"
+    members = np.arange(5_000, dtype=np.int64)
+    store.pfadd_many(key, members)
+    store.pfadd_many(key, members[:1_000])  # duplicates change nothing
+    est = store.pfcount(key)
+    expected = abs(est - 5_000) / 5_000
+    g = t.registry.gauge("attendance_hll_measured_rel_error", key=key)
+    assert g.value == pytest.approx(expected)
+    assert expected < 0.02  # the ROADMAP ceiling holds on this run
+
+
+def test_false_negative_is_detected_and_screamed():
+    """A lying sketch (answers absent for added keys) must increment
+    the must-stay-zero counter — the auditor exists to catch exactly
+    this class of kernel bug in production."""
+    reg = Registry()
+    aud = ShadowAuditor(reg, 1.0)
+    keys = np.arange(100, dtype=np.uint32)
+    aud.record_bf_add("bf", keys)
+    aud.check_bf_exists("bf", keys, np.zeros(100, dtype=bool))
+    assert aud._fn.value == 100
+
+
+def test_unaudited_runs_pay_nothing():
+    """audit_sample=0 leaves no auditor anywhere: stores hold None and
+    pay one branch per command."""
+    cfg = Config(sketch_backend="memory")
+    store = make_sketch_store(cfg)
+    assert store._auditor is None
+    assert obs.get() is None
+
+
+def test_redis_sim_answers_are_audited_too():
+    """The simulated-Redis backend reimplements the command surface
+    wholesale; its overrides moved to the _u32 chokepoints so the
+    audit still sees every answer."""
+    cfg = Config(sketch_backend="redis-sim", audit_sample=1.0)
+    t = obs.enable(cfg)
+    store = make_sketch_store(cfg)
+    store.bf_add_many("bf:students", np.arange(500, dtype=np.int64))
+    store.bf_exists_many("bf:students",
+                         np.arange(1_000, dtype=np.int64))
+    assert t.auditor._negatives.value == 500
+    assert t.auditor._fn.value == 0
+
+
+# -- fused-path auditing -----------------------------------------------------
+
+def _fused_run(config, num_events=4_096, frame=1_024, roster_size=4_000):
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    roster, frames = generate_frames(num_events, frame,
+                                     roster_size=roster_size,
+                                     num_lectures=4)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=num_events, idle_timeout_s=0.3)
+    return pipe, roster
+
+
+def test_fused_audit_gauges_agree_with_exact_recount():
+    config = Config(bloom_filter_capacity=5_000, audit_sample=1.0)
+    t = obs.enable(config)
+    pipe, roster = _fused_run(config)
+
+    # Exact ground truth at sample=1.0: recount the stored traffic
+    # against the true roster, independently of the auditor.
+    cols = pipe.store.to_columns(deduplicate=False)
+    sids = np.asarray(cols["student_id"], dtype=np.uint32)
+    days = np.asarray(cols["lecture_day"])
+    roster_set = set(int(k) for k in roster)
+    valid = np.fromiter((int(s) in roster_set for s in sids),
+                        dtype=bool, count=len(sids))
+    exact_per_day = {}
+    for d, s in zip(days[valid], sids[valid]):
+        exact_per_day.setdefault(int(d), set()).add(int(s))
+    truth_total = sum(len(v) for v in exact_per_day.values())
+    est_total = sum(pipe.count_all().values())
+    expected_rel = abs(est_total - truth_total) / truth_total
+
+    g_err = t.registry.gauge("attendance_hll_measured_rel_error",
+                             key="fused")
+    assert g_err.value == pytest.approx(expected_rel, abs=1e-9)
+    assert expected_rel < 0.02
+
+    # Measured FPR: the scrape-time device re-query over the sampled
+    # negative traffic, vs an offline re-query of the same probe set.
+    g_fpr = t.registry.gauge("attendance_bloom_measured_fpr",
+                             surface="fused")
+    measured = g_fpr.value
+    from attendance_tpu.models.bloom import bloom_contains_words
+    negatives = np.fromiter((int(s) for s in set(sids.tolist())
+                             - roster_set), dtype=np.uint32)
+    answers = np.asarray(bloom_contains_words(
+        pipe.state.bloom_bits, negatives, pipe.params))
+    assert measured == pytest.approx(answers.mean())
+    assert t.auditor._fn.value == 0  # no roster key answered absent
+
+
+# -- SLO window math ---------------------------------------------------------
+
+def _engine(tmp_path, **kw):
+    t = obs.enable(Config(flight_recorder=8))
+    eng = SloEngine(t, (), fast_s=4.0, slow_s=20.0,
+                    path=str(tmp_path / "alerts.jsonl"), **kw)
+    fpr = t.registry.gauge("attendance_bloom_measured_fpr")
+    return t, eng, fpr, eng._state["bloom_measured_fpr"]
+
+
+def test_sustained_breach_fires(tmp_path):
+    t, eng, fpr, st = _engine(tmp_path)
+    fpr.set(0.005)
+    for i in range(25):
+        eng.tick(now=float(i))
+    assert not st.firing
+    fpr.set(0.05)
+    for i in range(25, 50):
+        eng.tick(now=float(i))
+    assert st.firing
+    events = [json.loads(l) for l in
+              (tmp_path / "alerts.jsonl").read_text().splitlines()]
+    assert events[-1]["slo"] == "bloom_measured_fpr"
+    assert events[-1]["state"] == "firing"
+    assert events[-1]["burn_fast"] >= eng.fire_burn
+    assert events[-1]["burn_slow"] >= eng.fire_burn
+    # The transition is flagged in the flight ring for forensics.
+    alerts = [r for r in t.flight.snapshot() if "alert" in r]
+    assert alerts and alerts[-1]["alert"] == "bloom_measured_fpr"
+    # ...and the burn gauges are on the scrape surface.
+    text = t.render()
+    assert 'attendance_slo_firing{slo="bloom_measured_fpr"} 1' in text
+    assert "attendance_slo_burn_rate" in text
+
+
+def test_single_window_spike_does_not_fire(tmp_path):
+    """A spike shorter than fire_burn * budget of the slow window must
+    not page — the classic multi-window rationale."""
+    t, eng, fpr, st = _engine(tmp_path)
+    fpr.set(0.005)
+    for i in range(21):
+        eng.tick(now=float(i))
+    fpr.set(0.05)  # 2-tick spike: 10% of the slow window
+    for i in range(21, 23):
+        eng.tick(now=float(i))
+    fpr.set(0.005)
+    for i in range(23, 44):
+        eng.tick(now=float(i))
+    assert not st.firing
+    log = tmp_path / "alerts.jsonl"
+    assert not log.exists() or log.read_text() == ""
+
+
+def test_alert_clears_with_hysteresis(tmp_path):
+    t, eng, fpr, st = _engine(tmp_path)
+    fpr.set(0.05)
+    for i in range(25):
+        eng.tick(now=float(i))
+    assert st.firing
+    # Oscillation around the ceiling: breaches keep landing in the
+    # fast window — burn stays above the clear threshold, no flapping.
+    for i in range(25, 33):
+        fpr.set(0.05 if i % 2 else 0.005)
+        eng.tick(now=float(i))
+    assert st.firing
+    # Sustained recovery: the fast window drains below half the firing
+    # burn and the alert resolves exactly once.
+    fpr.set(0.001)
+    for i in range(33, 45):
+        eng.tick(now=float(i))
+    assert not st.firing
+    states = [json.loads(l)["state"] for l in
+              (tmp_path / "alerts.jsonl").read_text().splitlines()]
+    assert states == ["firing", "resolved"]
+
+
+def test_first_tick_breach_does_not_fire(tmp_path):
+    """The burn denominator is the window's EXPECTED sample count: one
+    transiently-bad tick in a near-empty window must not page (a
+    1-sample window would otherwise read as a 100%-breach window)."""
+    t, eng, fpr, st = _engine(tmp_path)
+    fpr.set(0.05)
+    eng.tick(now=0.0)
+    eng.tick(now=1.0)
+    assert not st.firing
+    log = tmp_path / "alerts.jsonl"
+    assert not log.exists() or log.read_text() == ""
+
+
+def test_roster_shadow_overflow_disables_fused_audit(monkeypatch):
+    """A roster larger than the shadow cap must STOP the fused
+    measurement (empty probe sets, NaN gauges), never classify traffic
+    against the vanished ground truth — which would read every valid
+    key as a false positive."""
+    import attendance_tpu.obs.audit as audit_mod
+
+    monkeypatch.setattr(audit_mod, "SHADOW_CAP", 100)
+    reg = Registry()
+    aud = ShadowAuditor(reg, 1.0)
+    aud.record_roster(np.arange(1_000, dtype=np.uint32))
+    assert aud._overflow.value == 1
+    aud.observe_fused_frame(np.arange(500, dtype=np.uint32),
+                            np.zeros(500, dtype=np.int64))
+    roster, negatives = aud.fused_probe_sets()
+    assert len(roster) == 0 and len(negatives) == 0
+    assert aud.fused_day_truth() == {}
+
+
+def test_traffic_reservoir_freezes_at_cap(monkeypatch):
+    """The traffic probe population freezes at the cap (one overflow
+    count, no per-frame eviction) and keeps measuring over the frozen
+    set."""
+    import attendance_tpu.obs.audit as audit_mod
+
+    monkeypatch.setattr(audit_mod, "SHADOW_CAP", 200)
+    reg = Registry()
+    aud = ShadowAuditor(reg, 1.0)
+    aud.record_roster(np.arange(50, dtype=np.uint32))
+    for lo in (0, 300, 600):
+        aud.observe_fused_frame(
+            np.arange(lo, lo + 300, dtype=np.uint32),
+            np.zeros(300, dtype=np.int64))
+    assert aud._overflow.value == 1  # once, not per frame
+    roster, negatives = aud.fused_probe_sets()
+    assert len(roster) == 50
+    assert 0 < len(negatives) <= 300
+
+
+def test_no_signal_is_not_a_breach(tmp_path):
+    """A NaN gauge (no sampled negative query yet) must not burn
+    budget: silence is absence of evidence, not failure."""
+    t, eng, fpr, st = _engine(tmp_path)
+    for i in range(30):
+        eng.tick(now=float(i))  # gauge still 0.0 default... set NaN
+    fpr.set(float("nan"))
+    for i in range(30, 60):
+        eng.tick(now=float(i))
+    assert not st.firing
+
+
+def test_throughput_and_quantile_slos(tmp_path):
+    t = obs.enable(Config(flight_recorder=4))
+    eng = SloEngine(t, ("throughput>=100", "dequeue_p99<=0.1"),
+                    fast_s=4.0, slow_s=20.0,
+                    path=str(tmp_path / "a.jsonl"))
+    ev = t.registry.counter("attendance_events_total")
+    h = t.stage("dequeue_wait")
+    for i in range(30):
+        ev.inc(10)  # 10 events/tick = 10/s < 100 floor -> breach
+        h.observe(0.5)  # every fresh observation breaches the p99
+        eng.tick(now=float(i))
+    assert eng._state["throughput"].firing
+    assert eng._state["dequeue_p99"].firing
+    events = [json.loads(l) for l in
+              (tmp_path / "a.jsonl").read_text().splitlines()]
+    assert {e["slo"] for e in events} == {"throughput", "dequeue_p99"}
+
+
+def test_parse_slo_specs():
+    s = parse_slo("fpr<=0.02")
+    assert (s.name, s.op, s.threshold) == ("bloom_measured_fpr", "<=",
+                                           0.02)
+    s = parse_slo("throughput>=1e6")
+    assert s.kind == "rate" and s.threshold == 1e6
+    s = parse_slo("device_p95<=0.25")
+    assert s.kind == "quantile" and s.quantile == 0.95
+    assert s.label_filter == ("stage", "device_wait")
+    with pytest.raises(ValueError):
+        parse_slo("nonsense<=1")
+    with pytest.raises(ValueError):
+        parse_slo("fpr=0.01")
+    # A user spec naming a default REPLACES it.
+    slos = resolve_slos(["fpr<=0.5"])
+    assert [s.threshold for s in slos
+            if s.name == "bloom_measured_fpr"] == [0.5]
+    assert len([s for s in slos if s.name == "bloom_false_negatives"]
+               ) == 1
+
+
+# -- quantiles ---------------------------------------------------------------
+
+def test_histogram_quantile():
+    reg = Registry()
+    h = reg.histogram("h", scale=1.0)
+    assert math.isnan(h.quantile(0.5))
+    for v in (1, 1, 1, 1, 1, 1, 1, 1, 1, 100):
+        h.observe(v)
+    # p50 lands in bucket [1,2); p99 in [64,128) — the bucket holding
+    # the 100 — and never claims a value below its lower bound.
+    assert 1.0 <= h.quantile(0.50) <= 2.0
+    assert 64.0 <= h.quantile(0.99) <= 128.0
+    # Overflow honesty: a rank past the last finite bound answers +Inf.
+    assert quantile_from_buckets([1], 2, 0.99, scale=1.0) == float(
+        "inf")
+
+
+def test_telemetry_verb_renders_quantiles(tmp_path, capsys):
+    from attendance_tpu.cli import main
+    from attendance_tpu.obs.exposition import render
+
+    reg = Registry()
+    h = reg.histogram("attendance_stage_latency_seconds",
+                      stage="dequeue_wait")
+    for _ in range(90):
+        h.observe(0.001)
+    for _ in range(10):
+        h.observe(1.0)
+    prom = tmp_path / "m.prom"
+    prom.write_text("# scrape 1.0\n" + render(reg))
+    main(["telemetry", str(prom)])
+    out = capsys.readouterr().out
+    assert "p50=" in out and "p95=" in out and "p99=" in out
+    # p99 reflects the 1s outlier's bucket, not the 1ms mode.
+    p99 = float(out.split("p99=")[1].split()[0])
+    assert p99 > 0.5
+
+
+# -- restore-then-scrape (health gauges survive restore) ---------------------
+
+def test_store_health_gauges_survive_snapshot_restore(tmp_path):
+    from attendance_tpu.utils.snapshot import (
+        restore_sketch_store, snapshot_sketch_store)
+
+    cfg = Config(sketch_backend="memory", metrics_port=-1)
+    t = obs.enable(cfg)
+    store = make_sketch_store(cfg)
+    store.bf_add_many(cfg.bloom_filter_key,
+                      np.arange(1_000, dtype=np.int64))
+    store.pfadd_many(f"{cfg.hll_key_prefix}LECTURE_1",
+                     np.arange(2_000, dtype=np.int64))
+    before = t.registry.gauge("attendance_hll_estimate",
+                              backend="memory").value
+    assert before > 0
+    path = tmp_path / "sketch.npz"
+    snapshot_sketch_store(store, path)
+
+    # Restore REPLACES the store's innards; a fresh process would also
+    # build a brand-new store. Both must resume reporting.
+    restored = make_sketch_store(cfg)
+    restore_sketch_store(restored, path)
+    del store  # the old generation is gone — gauges must not go stale
+    g = t.registry.gauge("attendance_hll_estimate", backend="memory")
+    assert g.value == pytest.approx(before)
+    fill = t.registry.gauge("attendance_bloom_fill_fraction",
+                            backend="memory").value
+    assert 0 < fill < 1
+    # The scrape surface renders them (no skipped-sample warnings).
+    text = t.render()
+    assert 'attendance_bloom_estimated_fpr{backend="memory"}' in text
+
+
+def test_restored_tpu_store_resumes_reporting(tmp_path):
+    from attendance_tpu.utils.snapshot import (
+        restore_sketch_store, snapshot_sketch_store)
+
+    cfg = Config(sketch_backend="tpu", metrics_port=-1)
+    t = obs.enable(cfg)
+    store = make_sketch_store(cfg)
+    store.pfadd_many(f"{cfg.hll_key_prefix}LECTURE_1",
+                     np.arange(500, dtype=np.int64))
+    before = t.registry.gauge("attendance_hll_estimate",
+                              backend="tpu").value
+    path = tmp_path / "sketch.npz"
+    snapshot_sketch_store(store, path)
+    # Same store object, innards replaced — the weakref'd gauges must
+    # read the RESTORED generation (the stale-closure regression).
+    restore_sketch_store(store, path)
+    g = t.registry.gauge("attendance_hll_estimate", backend="tpu")
+    assert g.value == pytest.approx(before)
+
+
+# -- doctor ------------------------------------------------------------------
+
+def _doctor_artifacts(tmp_path, breached: bool):
+    from attendance_tpu.obs.exposition import render
+
+    reg = Registry()
+    reg.gauge("attendance_bloom_measured_fpr").set(
+        0.02 if breached else 0.004)
+    reg.gauge("attendance_bloom_estimated_fpr").set(0.01)
+    reg.counter("attendance_bloom_false_negatives_total")
+    reg.gauge("attendance_hll_measured_rel_error",
+              key="hll:unique:LECTURE_1").set(0.005)
+    reg.gauge("attendance_slo_firing", slo="bloom_measured_fpr").set(
+        1.0 if breached else 0.0)
+    reg.gauge("attendance_slo_burn_rate", slo="bloom_measured_fpr",
+              window="slow").set(20.0 if breached else 0.0)
+    prom = tmp_path / "m.prom"
+    prom.write_text("# scrape 1.0\n" + render(reg))
+
+    alerts = tmp_path / "alerts.jsonl"
+    if breached:
+        alerts.write_text(json.dumps(
+            {"ts": 1.0, "slo": "bloom_measured_fpr",
+             "state": "firing", "threshold": 0.01, "value": 0.02,
+             "burn_fast": 75.0, "burn_slow": 20.0,
+             "trace": "00000000deadbeef"}) + "\n")
+    else:
+        alerts.write_text("")
+
+    flight = tmp_path / "flight.json"
+    flight.write_text(json.dumps({
+        "reason": "test", "pid": 1, "ring_size": 4, "total_records": 2,
+        "records": [
+            {"ts": 0.5, "events": 512, "trace": "00000000deadbeef"},
+            {"ts": 1.0, "alert": "bloom_measured_fpr",
+             "state": "firing", "trace": "00000000deadbeef"},
+        ] if breached else [{"ts": 0.5, "events": 512}]}))
+    return [str(prom), str(alerts), str(flight)]
+
+
+def test_doctor_verdict_golden_and_exit_codes(tmp_path):
+    from attendance_tpu.cli import main
+
+    paths = _doctor_artifacts(tmp_path, breached=True)
+    text, ok = doctor_report(paths)
+    assert not ok
+    assert text == GOLDEN.read_text()
+    with pytest.raises(SystemExit) as e:
+        main(["doctor"] + paths)
+    assert e.value.code == 1
+
+
+def test_doctor_passes_clean_artifacts(tmp_path, capsys):
+    from attendance_tpu.cli import main
+
+    paths = _doctor_artifacts(tmp_path, breached=False)
+    text, ok = doctor_report(paths)
+    assert ok
+    main(["doctor"] + paths)  # returns without SystemExit
+    assert "verdict: PASS" in capsys.readouterr().out
+
+
+def test_doctor_unreadable_artifacts_exit_2(tmp_path):
+    from attendance_tpu.cli import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["doctor", str(tmp_path / "missing.prom")])
+    assert e.value.code == 2
+    bad = tmp_path / "bad.bin"
+    bad.write_text("{not json")
+    with pytest.raises(SystemExit) as e:
+        main(["doctor", str(bad)])
+    assert e.value.code == 2
+
+
+def test_doctor_on_a_real_audited_run(tmp_path):
+    """End to end: a clean memory-store run's own artifacts pass; the
+    measured gauges land in the exposition the reporter wrote."""
+    config = Config(bloom_filter_capacity=5_000, audit_sample=1.0,
+                    metrics_prom=str(tmp_path / "m.prom"),
+                    alert_log=str(tmp_path / "alerts.jsonl"),
+                    flight_recorder=16)
+    t = obs.enable(config)
+    _fused_run(config)
+    obs.disable()  # writes the final exposition block
+    text, ok = doctor_report([str(tmp_path / "m.prom"),
+                              str(tmp_path / "alerts.jsonl")])
+    assert ok, text
+    assert "bloom measured FPR" in text
