@@ -348,7 +348,8 @@ def cmd_doctor(args) -> None:
     try:
         text, ok = doctor_report(
             args.artifacts, fpr_ceiling=args.fpr_ceiling,
-            hll_error_ceiling=args.hll_error_ceiling)
+            hll_error_ceiling=args.hll_error_ceiling,
+            snapshot_stall_ceiling=args.snapshot_stall_ceiling)
     except FileNotFoundError as e:
         logger.error("no such artifact: %s", e)
         sys.exit(2)
@@ -477,6 +478,11 @@ def main(argv=None) -> None:
                        "target)")
     p_doc.add_argument("--hll-error-ceiling", type=float, default=0.02,
                        help="measured HLL relative-error ceiling")
+    p_doc.add_argument("--snapshot-stall-ceiling", type=float,
+                       default=None,
+                       help="gate the snapshot_write/snapshot_blocked "
+                       "stage p99 (seconds) recovered from the prom "
+                       "histograms; omitted = informational only")
     p_doc.set_defaults(fn=cmd_doctor)
 
     p_par = sub.add_parser(

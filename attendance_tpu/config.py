@@ -98,6 +98,24 @@ class Config:
     # while acking would lose events).
     snapshot_dir: str = ""
     snapshot_every_batches: int = 0
+    # Snapshot pipeline mode. "delta" (default): barriers capture only
+    # the HLL banks touched since the last barrier (a host-side dirty
+    # set fed by the frames' day columns) into double-buffered async
+    # D2H staging; the background writer serializes staging ->
+    # delta-NNNN.npz files chained off the last full base snapshot by
+    # an fsync'd CHAIN.json manifest (atomic rename = the durability
+    # point), and acks for the barrier interval's frames release when
+    # the DELTA is durable (group commit) — the crash contract ("every
+    # acked event is in a durable snapshot") is unchanged while the
+    # barrier itself costs one buffer swap. "barrier": every snapshot
+    # writes the full sketch state (the pre-delta behavior; kept as
+    # the bisection/debug fallback).
+    snapshot_mode: str = "delta"
+    # Delta-chain compaction cadence: after this many delta files the
+    # writer folds the chain back into a full base snapshot (off the
+    # hot path, from its host register mirror) and deletes the deltas,
+    # bounding restore cost and chain length.
+    snapshot_compact_every: int = 16
     # Structured metrics sink ("" = disabled): append ONE JSON line of
     # run metrics (ProcessorMetrics.to_dict) per processor/bridge run —
     # the machine-readable counterpart of the human metrics log line
@@ -191,6 +209,13 @@ class Config:
             raise ValueError(f"unknown replica sync: {self.replica_sync}")
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
+        if self.snapshot_mode not in ("barrier", "delta"):
+            raise ValueError(
+                f"unknown snapshot mode: {self.snapshot_mode}")
+        if self.snapshot_compact_every <= 0:
+            raise ValueError(
+                "snapshot_compact_every must be positive (delta files "
+                "per chain before the writer folds a full base)")
         if not (-1 <= self.metrics_port <= 65535):
             raise ValueError(
                 f"metrics_port out of range: {self.metrics_port} "
@@ -269,6 +294,16 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
     p.add_argument("--snapshot-dir", default=d.snapshot_dir)
     p.add_argument("--snapshot-every-batches", type=int,
                    default=d.snapshot_every_batches)
+    p.add_argument("--snapshot-mode", choices=["barrier", "delta"],
+                   default=d.snapshot_mode,
+                   help="delta = incremental dirty-bank snapshots "
+                   "chained off a base by an fsync'd manifest, acks "
+                   "group-committed per durable delta; barrier = full "
+                   "sketch state per snapshot (pre-delta behavior)")
+    p.add_argument("--snapshot-compact-every", type=int,
+                   default=d.snapshot_compact_every,
+                   help="delta files per chain before the background "
+                   "writer folds them into a full base snapshot")
     p.add_argument("--wire-format",
                    choices=["auto", "delta", "seg", "word", "bytes"],
                    default=d.wire_format,
@@ -344,6 +379,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         replica_sync=args.replica_sync,
         snapshot_dir=args.snapshot_dir,
         snapshot_every_batches=args.snapshot_every_batches,
+        snapshot_mode=args.snapshot_mode,
+        snapshot_compact_every=args.snapshot_compact_every,
         wire_format=args.wire_format,
         invalid_topic=args.invalid_topic,
         max_redeliveries=args.max_redeliveries,

@@ -524,6 +524,31 @@ def pack_delta(keys, banks, db: int, padded: int, num_banks: int,
     return buf, perm
 
 
+def snapshot_capture_rows(regs: jax.Array, bank_idx: jax.Array,
+                          counts: jax.Array
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """Device-side capture of a snapshot DELTA: the HLL register rows
+    of the banks dirtied since the last barrier (``bank_idx``, padded
+    to a bounded set of lengths — callers slice the pad rows off
+    host-side) plus a copy of the two-lane validity counters.
+
+    The gather joins the dispatch queue AFTER every fused step of the
+    frames being snapshotted, so when the background writer's D2H of
+    the captured rows completes, those steps completed — the ack
+    barrier without draining the device or copying the whole filter
+    (the full-state copy this replaces moved every register bank per
+    snapshot; a 256-bank p=14 state is 4MB where one dirty bank is
+    16KB)."""
+    return regs[bank_idx], counts | jnp.uint32(0)
+
+
+def make_jitted_snapshot_capture():
+    """jit of :func:`snapshot_capture_rows` (one compile per padded
+    dirty-bank count; the pipeline pads to powers of two so a steady
+    dirty population compiles a couple of lengths)."""
+    return jax.jit(snapshot_capture_rows)
+
+
 def pack_bytes(keys, banks, bank_dtype, padded: int):
     """Host-side pack of the 5-byte fallback wire consumed by
     :func:`fused_step_bytes`: uint8[(4 + w) * padded] laid out as

@@ -28,6 +28,10 @@ Metric names (the stable scrape contract, asserted by tests):
   counters (messages, bytes, redeliveries).
 * ``attendance_shard_events{replica=...}`` — per-replica event totals
   of the sharded engine, aggregated at report time.
+* ``attendance_snapshot_delta_bytes`` /
+  ``attendance_snapshot_chain_length`` — size of the last incremental
+  snapshot delta and delta files since the last full base (the delta
+  checkpoint pipeline, pipeline/fast_path).
 * Sketch health (callback gauges, device reads ONLY at scrape time —
   see obs/health.py): ``attendance_bloom_fill_fraction`` and
   ``attendance_bloom_estimated_fpr`` (occupancy-based fill^k, the

@@ -457,7 +457,9 @@ def _fmt_value(v: Optional[float]) -> str:
 
 def _prom_checks(text: str, fpr_ceiling: float,
                  hll_error_ceiling: float,
-                 fire_burn: float) -> List[List[str]]:
+                 fire_burn: float,
+                 snapshot_stall_ceiling: Optional[float]
+                 ) -> List[List[str]]:
     from attendance_tpu.obs.exposition import parse_prom
 
     samples = parse_prom(text)
@@ -505,6 +507,38 @@ def _prom_checks(text: str, fpr_ceiling: float,
         drift = abs(max(measured) - max(estimated))
         rows.append(["FPR estimator drift", _fmt_value(drift), "-",
                      "info"])
+    # Snapshot stall ceiling: p99 of each snapshot stage histogram
+    # (write = one background write's wall, blocked = hot-loop waits
+    # on a full staging queue), recovered from the scraped cumulative
+    # buckets. Informational without a ceiling; a gate with one.
+    from attendance_tpu.obs.exposition import (
+        _parse_le, quantiles_from_cumulative)
+
+    for stage in ("snapshot_write", "snapshot_blocked"):
+        pairs = []
+        for name, labels, value in samples:
+            if (name == "attendance_stage_latency_seconds_bucket"
+                    and f'stage="{stage}"' in labels):
+                le = _parse_le(labels)
+                if le is not None:
+                    try:
+                        pairs.append((le, float(value)))
+                    except ValueError:
+                        continue
+        if not pairs or max(c for _, c in pairs) == 0:
+            continue  # run never snapshotted: nothing to judge
+        (p99,) = quantiles_from_cumulative(pairs, (0.99,))
+        if snapshot_stall_ceiling is None:
+            rows.append([f"{stage} p99", _fmt_value(p99), "-", "info"])
+        else:
+            rows.append([f"{stage} p99", _fmt_value(p99),
+                         f"<= {_fmt_value(snapshot_stall_ceiling)}",
+                         "PASS" if p99 <= snapshot_stall_ceiling
+                         else "FAIL"])
+    chain = _vals("attendance_snapshot_chain_length")
+    if chain:
+        rows.append(["snapshot chain length", _fmt_value(max(chain)),
+                     "-", "info"])
     firing = [(labels, v) for name, labels, v in samples
               if name == "attendance_slo_firing" and float(v) >= 1.0]
     rows.append(["SLO alerts firing at last scrape", str(len(firing)),
@@ -544,7 +578,8 @@ def _alert_checks(events: List[dict]) -> Tuple[List[List[str]],
 def doctor_report(paths: Sequence[str], *,
                   fpr_ceiling: float = 0.01,
                   hll_error_ceiling: float = 0.02,
-                  fire_burn: float = DEFAULT_FIRE_BURN
+                  fire_burn: float = DEFAULT_FIRE_BURN,
+                  snapshot_stall_ceiling: Optional[float] = None
                   ) -> Tuple[str, bool]:
     """Replay run artifacts offline; returns (verdict text, ok).
 
@@ -565,7 +600,8 @@ def doctor_report(paths: Sequence[str], *,
         artifacts.append(f"{kind}: {Path(path).name}")
         if kind == "prom":
             rows.extend(_prom_checks(payload, fpr_ceiling,
-                                     hll_error_ceiling, fire_burn))
+                                     hll_error_ceiling, fire_burn,
+                                     snapshot_stall_ceiling))
         elif kind == "alerts":
             arows, traces = _alert_checks(payload)
             rows.extend(arows)

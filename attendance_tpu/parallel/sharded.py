@@ -746,6 +746,17 @@ class ShardedSketchEngine:
         return (np.asarray(self.bits)[:real_words],
                 np.asarray(self._merge_regs(self.regs)))
 
+    def get_state_rows(self, bank_idx) -> np.ndarray:
+        """Merged HLL register rows for the given banks only — the
+        incremental-snapshot capture. The dp max-union runs on device
+        (the same compiled merge program every host read shares) and
+        the row gather indexes its replicated output ON DEVICE, so
+        only the k dirty rows cross the host link instead of
+        get_state()'s full register state. Runs the same collectives
+        on every process of a multi-host mesh."""
+        merged = self._merge_regs(self.regs)
+        return np.asarray(merged[np.asarray(bank_idx, dtype=np.int32)])
+
     def set_state(self, bits: np.ndarray, regs: np.ndarray) -> None:
         """Restore state captured by get_state (or by the single-chip
         pipeline) onto this mesh — state is global; only the allocation

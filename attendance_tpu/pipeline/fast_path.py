@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -85,6 +86,8 @@ from attendance_tpu.models.bloom import (  # noqa: E402,F401
 SKETCH_SNAPSHOT = "fused_sketch.npz"
 EVENTS_SNAPSHOT = "fused_events.npz"
 EVENTS_SEGMENTS = "fused_events_segs"
+CHAIN_MANIFEST = "CHAIN.json"  # fsync'd base+delta chain manifest
+_SNAP_QUEUE_DEPTH = 2  # staged delta captures in flight (double buffer)
 
 
 class _ScatterValidity:
@@ -261,10 +264,43 @@ class FusedPipeline:
         # read after the last preload serves every later snapshot
         # instead of a per-snapshot D2H of the whole filter.
         self._bloom_host: Optional[np.ndarray] = None
-        # Async snapshot writer (the BGSAVE analogue — _checkpoint_async).
+        # Incremental (delta) snapshot state — see _checkpoint_async.
+        # _dirty_days is fed by the hot loop (one cheap pass per frame,
+        # only when delta checkpointing is on) and drained at barriers
+        # into the dirty-bank capture; the chain bookkeeping below is
+        # owned by the background writer (serialized by its queue).
+        self._snap_mode = getattr(self.config, "snapshot_mode", "delta")
+        self._snap_compact_every = max(
+            1, getattr(self.config, "snapshot_compact_every", 16))
+        self._snap_dirty = (self._snap_dir is not None
+                            and self._snap_mode == "delta")
+        self._dirty_days: set = set()
+        self._base_stale = True     # no durable base for this run yet
+        self._writer_base_ok = False
+        self._snap_chain: list = []  # delta files since the base
+        self._delta_seq = 0
+        self._regs_mirror: Optional[np.ndarray] = None
+        self._snap_take = None  # jitted dirty-row capture (lazy)
+        # Async snapshot writer (the BGSAVE analogue): ONE persistent
+        # thread draining a bounded staging queue — two captures may be
+        # in flight (double buffering: the loop swaps into the second
+        # staging slot while the writer drains the first), each acked
+        # only once ITS delta/base is durable (group commit per
+        # barrier interval).
+        self._snap_jobs: deque = deque()
+        self._snap_cv = threading.Condition()
+        self._snap_pending = 0
         self._snap_thread: Optional[threading.Thread] = None
         self._snap_io_lock = threading.Lock()
         self._snap_copy = None
+        self._g_delta_bytes = self._g_chain_len = None
+        if self._obs is not None and self._snap_dir is not None:
+            self._g_delta_bytes = self._obs.registry.gauge(
+                "attendance_snapshot_delta_bytes",
+                help="Bytes of the last incremental snapshot delta")
+            self._g_chain_len = self._obs.registry.gauge(
+                "attendance_snapshot_chain_length",
+                help="Delta files since the last full base snapshot")
         if self._snap_dir is not None:
             self.restore()
         # Accuracy auditor (obs/audit.py): the hot loop only RECORDS
@@ -291,6 +327,10 @@ class FusedPipeline:
     def preload(self, keys) -> None:
         keys = np.asarray(keys, dtype=np.uint32)
         self._bloom_host = None  # invalidate the snapshot-path cache
+        # The filter changed: any existing base snapshot no longer
+        # covers it, so the next barrier must write a fresh full base
+        # before deltas (which never carry Bloom words) may chain on.
+        self._base_stale = True
         if self._auditor is not None:
             # The roster IS the filter's full membership (the hot loop
             # never BF.ADDs): its sampled subset is the shadow's
@@ -406,6 +446,13 @@ class FusedPipeline:
         n = len(cols["student_id"])
         if n == 0:
             return None
+        if self._snap_dirty:
+            # Delta checkpointing: note which lecture days this frame
+            # touches (barriers map them to dirty HLL banks). One
+            # bincount-class pass, wire-agnostic — it sees the days
+            # BEFORE dispatch, so even native packs that never
+            # materialize a host bank array are covered.
+            self._note_dirty(cols["lecture_day"])
         if self._auditor is not None:
             # Shadow recording only — no device read, no sync; the
             # sampled ~1% of lanes feed the scrape-time measured
@@ -1023,6 +1070,14 @@ class FusedPipeline:
             bits = self._bloom_host
             regs = np.asarray(self.state.hll_regs)
             counts = np.asarray(self.state.counts)
+        if self.sharded:
+            self._bloom_host = np.asarray(bits)
+        # A full snapshot covers every bank: the dirty set and the
+        # delta chain restart from it (on every process — the flags
+        # steer control flow and must not diverge across a mesh; a
+        # write failure on process 0 crashes the lockstep anyway).
+        self._dirty_days.clear()
+        self._regs_mirror = np.array(regs, dtype=np.uint8, copy=True)
         if jax.process_count() > 1 and jax.process_index() != 0:
             # Multi-controller lockstep (DCN cluster): every process
             # holds the same replicated state, so exactly one writes
@@ -1030,12 +1085,18 @@ class FusedPipeline:
             # (callers materialize outputs and ack) — they only skip
             # the duplicate FILE writes, which would race on a shared
             # snapshot_dir.
+            self._base_stale = False
+            self._writer_base_ok = True
             self._batches_at_snap = self.metrics.batches
             return
         with self._snap_io_lock:
             self._write_snapshot_files(bits, regs, counts,
                                        dict(self._bank_of),
                                        self.metrics.events, upto=None)
+        # Only after the write: a raise above leaves the next barrier
+        # still owing a full base.
+        self._base_stale = False
+        self._writer_base_ok = True
         self._batches_at_snap = self.metrics.batches
 
     def _write_snapshot_files(self, bits, regs, counts, bank_of: dict,
@@ -1051,6 +1112,13 @@ class FusedPipeline:
             "k": self.params.k,
             "precision": self.config.hll_precision,
             "events": events,
+            # Staleness fence for the one crash window the in-place
+            # base replace opens (new base lands, crash before the
+            # chain-manifest reset): deltas numbered <= this are OLDER
+            # than the base and must not be applied on top of it. The
+            # delta sequence is monotonic across restarts (restore
+            # scans the dir), unlike the per-process events counter.
+            "chain_seq": self._delta_seq,
         }
         # Event segments FIRST: a crash between the two writes leaves
         # extra store rows whose frames are still unacked — replay
@@ -1067,97 +1135,390 @@ class FusedPipeline:
             np.savez(f, bloom_words=bits, hll_regs=regs, counts=counts,
                      manifest=np.frombuffer(
                          json.dumps(manifest).encode(), dtype=np.uint8))
+            # fsync before the rename: the chain-manifest reset below
+            # unlinks the delta files this base supersedes, so page-
+            # cache durability is not enough for the base itself.
+            f.flush()
+            os.fsync(f.fileno())
         tmp.replace(path)
+        # A full base supersedes any delta chain: reset the manifest
+        # FIRST (restore must never apply stale deltas on top of this
+        # newer base), then delete the superseded delta files.
+        old = list(self._snap_chain)
+        self._snap_chain = []
+        self._write_chain_manifest()
+        for name in old:
+            try:
+                (self._snap_dir / name).unlink()
+            except OSError:
+                pass
+
+    def _write_chain_manifest(self) -> None:
+        """Atomically publish the base+delta chain (caller holds
+        _snap_io_lock) via the shared durable-manifest helper — the
+        rename IS the snapshot's durability point (a delta file a
+        crash orphaned before its manifest entry is ignored on
+        restore, and its frames redeliver)."""
+        from attendance_tpu.utils.snapshot import write_manifest_atomic
+
+        write_manifest_atomic(
+            self._snap_dir,
+            {"base": SKETCH_SNAPSHOT, "deltas": list(self._snap_chain)},
+            name=CHAIN_MANIFEST)
+
+    def _write_delta_files(self, banks: np.ndarray, rows: np.ndarray,
+                           counts, bank_of: dict, events: int,
+                           num_banks: int, upto) -> int:
+        """The file half of one incremental snapshot (caller holds
+        _snap_io_lock): event segments first (extra rows from a crash
+        before the manifest replay harmlessly through read-time
+        dedup), then the fsync'd delta npz, then the manifest rename
+        that makes the delta part of the restorable chain. Returns the
+        delta file's size in bytes."""
+        self._snap_dir.mkdir(parents=True, exist_ok=True)
+        if hasattr(self.store, "save_segments"):
+            self.store.save_segments(self._snap_dir / EVENTS_SEGMENTS,
+                                     upto=upto)
+        else:
+            self.store.save(self._snap_dir / EVENTS_SNAPSHOT)
+        from attendance_tpu.utils.snapshot import fsync_write_npz
+
+        manifest = {
+            "bank_of": {str(d): b for d, b in bank_of.items()},
+            "events": events,
+            "num_banks": num_banks,
+        }
+        self._delta_seq += 1
+        name = f"delta-{self._delta_seq:04d}.npz"
+        path = self._snap_dir / name
+        # fsync'd (shared helper): durable BEFORE the manifest names it.
+        fsync_write_npz(path, dict(
+            bank_idx=np.asarray(banks, np.int32),
+            regs_rows=np.asarray(rows, np.uint8),
+            counts=np.asarray(counts, np.uint32),
+            manifest=np.frombuffer(
+                json.dumps(manifest).encode(), dtype=np.uint8)))
+        self._snap_chain.append(name)
+        self._write_chain_manifest()
+        return path.stat().st_size
 
     def _flush_snapshots(self) -> None:
-        """Wait out any in-flight background snapshot write."""
-        t = self._snap_thread
-        if t is not None and t.is_alive():
+        """Wait out every in-flight background snapshot write."""
+        self._wait_snap_slots(0)
+
+    def _wait_snap_slots(self, below: int) -> None:
+        """Block until fewer than ``below`` + 1 staged writes remain
+        (0 = queue fully drained), recording the wait as hot-loop
+        snapshot backpressure."""
+        with self._snap_cv:
+            if self._snap_pending <= below:
+                return
             t0 = time.perf_counter()
-            t.join()
+            while self._snap_pending > below:
+                self._snap_cv.wait()
             blocked = time.perf_counter() - t0
-            self.metrics.snapshot_blocked_s += blocked
-            if self._obs is not None:
-                self._h_snap_blocked.observe(blocked)
+        self.metrics.snapshot_blocked_s += blocked
+        if self._obs is not None:
+            self._h_snap_blocked.observe(blocked)
+
+    # -- dirty-bank tracking (delta mode) ------------------------------------
+    def _note_dirty(self, days: np.ndarray) -> None:
+        """Record the lecture days one frame touches. Steady state
+        (all days inside the dense LUT window) costs a min/max pair
+        plus — only for multi-day frames — one bincount over the small
+        offset range; single-day frames are O(1)."""
+        days_u32 = np.ascontiguousarray(days, dtype=np.uint32)
+        base = self._day_base
+        if base is not None:
+            off = (days_u32 - np.uint32(base)).view(np.int32)
+            mn, mx = int(off.min()), int(off.max())
+            if 0 <= mn and mx < self._LUT_SIZE:
+                if mn == mx:
+                    self._dirty_days.add(mn + base)
+                else:
+                    seen = np.bincount(off - mn, minlength=1)
+                    self._dirty_days.update(
+                        (np.nonzero(seen)[0] + (mn + base)).tolist())
+                return
+        self._dirty_days.update(
+            np.unique(days_u32.astype(np.int64)).tolist())
+
+    def _drain_dirty_banks(self) -> np.ndarray:
+        """Swap out the dirty-day set and resolve it to sorted HLL bank
+        indices (every dispatched day registered a bank; unregistered
+        stragglers — e.g. days seen only in all-padding frames — are
+        simply not dirty)."""
+        days, self._dirty_days = self._dirty_days, set()
+        banks = sorted(self._bank_of[d] for d in days
+                       if d in self._bank_of)
+        return np.asarray(banks, dtype=np.int32)
+
+    @staticmethod
+    def _pad_bank_index(banks: np.ndarray) -> np.ndarray:
+        """Dirty-bank indices padded to a power of two (min 8) so a
+        steady dirty population compiles a couple of gather shapes,
+        not one per distinct dirty count. Pad rows gather bank 0 and
+        are sliced off host-side."""
+        padded = 8
+        while padded < len(banks):
+            padded *= 2
+        idx = np.zeros(padded, np.int32)
+        idx[:len(banks)] = banks
+        return idx
+
+    def _post_delta_bookkeeping(self, banks, rows, nbytes: int,
+                                counts, bank_of: dict, events: int,
+                                num_banks: int) -> None:
+        """Shared tail of every delta write (async writer and mesh
+        sync path): fold the rows into the host mirror, publish the
+        gauges, and fold the chain into a fresh base when it reached
+        the compaction cadence."""
+        self._apply_mirror_rows(banks, rows, num_banks)
+        if self._g_delta_bytes is not None:
+            self._g_delta_bytes.set(float(nbytes))
+            self._g_chain_len.set(float(len(self._snap_chain)))
+        if len(self._snap_chain) >= self._snap_compact_every:
+            self._compact_chain(counts, bank_of, events)
+
+    # -- background writer ---------------------------------------------------
+    def _enqueue_snap(self, job: dict) -> None:
+        with self._snap_cv:
+            if self._snap_thread is None or not self._snap_thread.is_alive():
+                import weakref
+                self._snap_thread = threading.Thread(
+                    target=FusedPipeline._snap_writer_main,
+                    args=(weakref.ref(self), self._snap_cv,
+                          self._snap_jobs),
+                    name="snapshot-writer", daemon=True)
+                self._snap_thread.start()
+            self._snap_jobs.append(job)
+            self._snap_pending += 1
+            self._snap_cv.notify_all()
+
+    def _stop_snap_writer(self) -> None:
+        """Shut the writer down (cleanup path): sentinel + join, after
+        the queue drained."""
+        with self._snap_cv:
+            t = self._snap_thread
+            if t is None or not t.is_alive():
+                return
+            self._snap_jobs.append(None)
+            self._snap_cv.notify_all()
+        t.join(timeout=10.0)
         self._snap_thread = None
+
+    @staticmethod
+    def _snap_writer_main(pipe_ref, cv, jobs) -> None:
+        """The persistent snapshot writer: drains staged captures in
+        barrier order, makes each durable (D2H -> files -> manifest
+        rename), and releases the interval's acks as ONE group commit.
+        A failed write leaves its frames unacked (redelivery replays
+        them into idempotent sinks) and forces the next barrier to
+        write a fresh full base, restoring the chain invariant.
+
+        Holds only a WEAKREF to the pipeline between jobs (plus a
+        cleanup sentinel): a pipeline dropped without cleanup() is
+        still collectable, and the parked thread notices within a
+        second and exits instead of pinning the device state forever."""
+        while True:
+            with cv:
+                while not jobs:
+                    if pipe_ref() is None:
+                        return  # pipeline collected: nothing to write
+                    cv.wait(timeout=1.0)
+                job = jobs.popleft()
+            if job is None:
+                return  # cleanup sentinel
+            pipe = pipe_ref()
+            if pipe is None:
+                return  # frames stay unacked; process is tearing down
+            pipe._run_snap_job_logged(job)
+
+    def _run_snap_job_logged(self, job: dict) -> None:
+        t0 = time.perf_counter()
+        try:
+            self._run_snap_job(job)
+            acknowledge_all(self.consumer, job["msgs"])
+        except Exception:
+            self._base_stale = True
+            if job["kind"] == "base":
+                # The on-disk base is stale/absent: any delta job
+                # already staged behind this one must NOT chain onto
+                # it — the guard in _run_snap_job fails those jobs too
+                # (their frames redeliver) until a fresh base lands.
+                self._writer_base_ok = False
+            logger.exception("Background snapshot failed")
+        finally:
+            t_done = time.perf_counter()
+            stall = t_done - t0
+            self.metrics.snapshot_stalls.append(stall)
+            if self._obs is not None:
+                self._h_snap_write.observe(stall)
+                if self._tracer is not None:
+                    self._tracer.add_span(
+                        "snapshot_write", t0, t_done,
+                        trace_id=self._tracer.new_id(),
+                        role=self._TRACE_ROLE,
+                        args={"events_at": job["events"],
+                              "kind": job["kind"]})
+            with self._snap_cv:
+                self._snap_pending -= 1
+                self._snap_cv.notify_all()
+
+    def _run_snap_job(self, job: dict) -> None:
+        if job["kind"] == "base":
+            regs_h, counts_h = jax.device_get(
+                (job["regs"], job["counts"]))
+            regs_h = np.asarray(regs_h)
+            with self._snap_io_lock:
+                self._write_snapshot_files(
+                    job["bloom"], regs_h, counts_h, job["bank_of"],
+                    job["events"], job["upto"])
+            self._regs_mirror = np.array(regs_h, dtype=np.uint8,
+                                         copy=True)
+            self._writer_base_ok = True
+            if self._g_chain_len is not None:
+                self._g_chain_len.set(0.0)
+            return
+        if not self._writer_base_ok:
+            raise RuntimeError(
+                "delta capture with no durable base (an earlier base "
+                "write failed); frames stay unacked and the next "
+                "barrier writes a full base")
+        banks = job["banks"]
+        rows_h, counts_h = jax.device_get((job["rows"], job["counts"]))
+        rows_h = np.asarray(rows_h)[:len(banks)]
+        with self._snap_io_lock:
+            nbytes = self._write_delta_files(
+                banks, rows_h, counts_h, job["bank_of"], job["events"],
+                job["num_banks"], job["upto"])
+        self._post_delta_bookkeeping(banks, rows_h, nbytes, counts_h,
+                                     job["bank_of"], job["events"],
+                                     job["num_banks"])
+
+    def _apply_mirror_rows(self, banks, rows: np.ndarray,
+                           num_banks: int) -> None:
+        """Fold one delta into the writer's host register mirror (what
+        compaction folds back into a base without any extra D2H)."""
+        mirror = self._regs_mirror
+        if mirror is None:
+            return
+        if num_banks > mirror.shape[0]:
+            grown = np.zeros((num_banks, mirror.shape[1]), np.uint8)
+            grown[:mirror.shape[0]] = mirror
+            self._regs_mirror = mirror = grown
+        if len(banks):
+            mirror[np.asarray(banks, np.int64)] = rows
+
+    def _compact_chain(self, counts_h, bank_of: dict,
+                       events: int) -> None:
+        """Fold the delta chain back into a full base snapshot — in
+        the WRITER, off the hot path, from the host mirror (no device
+        traffic). Also merges the store's on-disk event segments so a
+        long checkpointed run's restore cost stays bounded."""
+        if self._regs_mirror is None or self._bloom_host is None:
+            return
+        with self._snap_io_lock:
+            self._write_snapshot_files(
+                self._bloom_host, self._regs_mirror, counts_h,
+                bank_of, events, upto=None)
+            if hasattr(self.store, "compact_segments"):
+                # Safe here: this writer thread is the only
+                # save_segments caller, so the no-concurrent-writer
+                # contract holds by construction.
+                self.store.compact_segments(
+                    self._snap_dir / EVENTS_SEGMENTS)
+        if self._g_chain_len is not None:
+            self._g_chain_len.set(0.0)
 
     def _checkpoint_async(self, force: bool) -> None:
         """The BGSAVE analogue (single-chip path): capture a consistent
-        point and hand the writes to a background thread, acking the
+        point and hand the writes to the background writer, acking the
         captured frames only once they are durable.
 
-        The capture is a DEVICE-SIDE copy of the mutating state (HLL
-        registers + counters; the Bloom filter is run-static — see
-        _bloom_host): it joins the dispatch queue after every step of
-        the frames being snapshotted, so when the writer's D2H of the
-        copy completes, those steps completed — the ack barrier without
-        stopping the hot loop. The reference gets this for free from
-        Redis BGSAVE / Cassandra sstables (SURVEY.md §5); a synchronous
-        snapshot here measured ~235x slower end to end (bench r05).
+        The capture is a DEVICE-SIDE copy of the mutating state — in
+        delta mode a gather of just the HLL banks dirtied since the
+        last barrier (models.fused.snapshot_capture_rows; the Bloom
+        filter is run-static, see _bloom_host), in barrier mode the
+        full register state. Either way it joins the dispatch queue
+        after every step of the frames being snapshotted, so when the
+        writer's D2H of the capture completes, those steps completed —
+        the ack barrier without stopping the hot loop. The reference
+        gets this from Redis BGSAVE's copy-on-write fork (SURVEY.md
+        §5); the TPU-native analogue snapshots the STATE, not the
+        process, and the delta capture shrinks it to the touched
+        banks.
 
-        One write in flight at a time: a busy writer defers the barrier
-        (cadence self-regulates to writer throughput) unless ``force``
-        (in-flight depth bound hit), which blocks and records the wait
-        as metrics.snapshot_blocked_s."""
-        if self._snap_thread is not None and self._snap_thread.is_alive():
+        Up to _SNAP_QUEUE_DEPTH captures may be staged (double
+        buffering); past that a barrier is DEFERRED (cadence
+        self-regulates to writer throughput) unless ``force``
+        (in-flight depth bound hit), which blocks for one slot and
+        records the wait as metrics.snapshot_blocked_s."""
+        depth = (_SNAP_QUEUE_DEPTH if self._snap_mode == "delta"
+                 else 1)
+        if self._snap_pending >= depth:
             if not force:
                 return  # defer: re-checked on a later frame
-            self._flush_snapshots()
-        if self._snap_copy is None:
-            self._snap_copy = jax.jit(lambda r, c: (r | 0, c | 0))
-        regs_c, counts_c = self._snap_copy(self.state.hll_regs,
-                                           self.state.counts)
+            self._wait_snap_slots(depth - 1)
         if self._bloom_host is None:
             # One-time (run-static filter), in the MAIN thread: the
             # writer must never host-read the live donated state chain.
             self._bloom_host = np.asarray(self.state.bloom_bits)
-        bloom_host = self._bloom_host
-        upto = (self.store.mark()
-                if hasattr(self.store, "mark") else None)
-        msgs = [m for m, _, _ in self._inflight]
+        if self._snap_mode == "delta" and not self._base_stale:
+            banks = self._drain_dirty_banks()
+            idx = self._pad_bank_index(banks)
+            if self._snap_take is None:
+                from attendance_tpu.models.fused import (
+                    make_jitted_snapshot_capture)
+                self._snap_take = make_jitted_snapshot_capture()
+            rows_c, counts_c = self._snap_take(self.state.hll_regs,
+                                               jax.numpy.asarray(idx),
+                                               self.state.counts)
+            job = dict(kind="delta", banks=banks, rows=rows_c,
+                       counts=counts_c,
+                       num_banks=self.state.hll_regs.shape[0])
+        else:
+            if self._snap_copy is None:
+                self._snap_copy = jax.jit(lambda r, c: (r | 0, c | 0))
+            regs_c, counts_c = self._snap_copy(self.state.hll_regs,
+                                               self.state.counts)
+            # The base covers every bank: restart the dirty set and
+            # chain from it. (If the write later fails, the writer
+            # flips _base_stale back and the next barrier re-captures
+            # everything in a fresh base.)
+            self._dirty_days.clear()
+            self._base_stale = False
+            job = dict(kind="base", regs=regs_c, counts=counts_c,
+                       bloom=self._bloom_host)
+        job.update(
+            upto=(self.store.mark()
+                  if hasattr(self.store, "mark") else None),
+            msgs=[m for m, _, _ in self._inflight],
+            events=self.metrics.events,
+            bank_of=dict(self._bank_of))
         self._inflight.clear()
         self._batches_at_snap = self.metrics.batches
-        events_at = self.metrics.events
-        bank_of = dict(self._bank_of)
-
-        def write() -> None:
-            t0 = time.perf_counter()
-            try:
-                regs_h, counts_h = jax.device_get((regs_c, counts_c))
-                with self._snap_io_lock:
-                    self._write_snapshot_files(
-                        bloom_host, regs_h, counts_h, bank_of,
-                        events_at, upto)
-                acknowledge_all(self.consumer, msgs)
-            except Exception:
-                # Frames stay unacked -> redelivery replays them
-                # (idempotent sketches + read-time dedup make the
-                # replay safe); the hot loop keeps running.
-                logger.exception("Background snapshot failed")
-            finally:
-                t_done = time.perf_counter()
-                stall = t_done - t0
-                self.metrics.snapshot_stalls.append(stall)
-                if self._obs is not None:
-                    self._h_snap_write.observe(stall)
-                    if self._tracer is not None:
-                        self._tracer.add_span(
-                            "snapshot_write", t0, t_done,
-                            trace_id=self._tracer.new_id(),
-                            role=self._TRACE_ROLE,
-                            args={"events_at": events_at})
-
-        self._snap_thread = threading.Thread(
-            target=write, name="snapshot-writer", daemon=True)
-        self._snap_thread.start()
+        self._enqueue_snap(job)
 
     def restore(self) -> bool:
-        """Load the latest snapshot from snapshot_dir, if one exists."""
+        """Load the latest snapshot from snapshot_dir, if one exists:
+        the base npz plus — when a CHAIN.json manifest is present —
+        every delta it names, applied in order (dirty-bank register
+        rows, counter totals, and the bank map / event count of the
+        last delta win). Delta files on disk that the manifest does
+        NOT name are crash orphans (written but never made durable by
+        a manifest rename) and are ignored; their frames were never
+        acked and redeliver."""
         if self._snap_dir is None:
             return False
         path = self._snap_dir / SKETCH_SNAPSHOT
         if not path.exists():
             return False
+        chain: list = []
+        chain_path = self._snap_dir / CHAIN_MANIFEST
+        if chain_path.exists():
+            chain = list(json.loads(
+                chain_path.read_text()).get("deltas", []))
         with np.load(path) as data:
             manifest = json.loads(bytes(data["manifest"]).decode())
             if manifest["m_bits"] != self.params.m_bits:
@@ -1171,25 +1532,63 @@ class FusedPipeline:
                     f"but config requests {self.config.hll_precision} — "
                     "register banks are not convertible across precisions")
             bits = data["bloom_words"]
-            regs = data["hll_regs"]
+            regs = np.array(data["hll_regs"], dtype=np.uint8)
             counts = (data["counts"] if "counts" in data
                       else np.zeros((2, 2), np.uint32))
-            # The bank map must be consistent with the register banks it
-            # routes into — a stale/hand-edited manifest that references
-            # banks beyond the restored array would silently misroute
-            # every PFADD for those days. Fail loudly instead.
-            bank_vals = [int(b) for b in manifest["bank_of"].values()]
-            if bank_vals:
-                if len(set(bank_vals)) != len(bank_vals):
-                    raise ValueError(
-                        "snapshot manifest maps two days to one HLL bank"
-                        " — manifest is corrupt")
-                if max(bank_vals) >= regs.shape[0]:
-                    raise ValueError(
-                        f"snapshot manifest references bank "
-                        f"{max(bank_vals)} but only {regs.shape[0]} "
-                        "register banks were restored — manifest and "
-                        "registers are from different snapshots")
+        bank_of_raw = manifest["bank_of"]
+        events = manifest["events"]
+        # Staleness fence (see _write_snapshot_files): a crash between
+        # a full base's in-place replace and the chain-manifest reset
+        # leaves the old delta list naming files OLDER than the base —
+        # every legit delta's sequence number exceeds the chain_seq
+        # its base recorded. Applying a stale one would regress
+        # registers and shear bank_of off the register banks. Bases
+        # from before this field never coexist with a chain manifest.
+        base_seq = int(manifest.get("chain_seq", -1))
+        applied: list = []
+        for name in chain:
+            dpath = self._snap_dir / name
+            if not dpath.exists():
+                raise ValueError(
+                    f"chain manifest names {name} but the delta file "
+                    "is missing — snapshot directory is corrupt")
+            if int(name.split("-")[1].split(".")[0]) <= base_seq:
+                continue  # stale: older than the restored base
+            with np.load(dpath) as d:
+                dman = json.loads(bytes(d["manifest"]).decode())
+                nb = int(dman.get("num_banks", regs.shape[0]))
+                if nb > regs.shape[0]:
+                    grown = np.zeros((nb, regs.shape[1]), np.uint8)
+                    grown[:regs.shape[0]] = regs
+                    regs = grown
+                idx = np.asarray(d["bank_idx"], np.int64)
+                if len(idx):
+                    if int(idx.max()) >= regs.shape[0]:
+                        raise ValueError(
+                            f"delta {name} writes bank {int(idx.max())}"
+                            f" but the chain only restored "
+                            f"{regs.shape[0]} banks — chain is corrupt")
+                    regs[idx] = d["regs_rows"]
+                counts = np.array(d["counts"], np.uint32)
+                bank_of_raw = dman["bank_of"]
+                events = dman["events"]
+            applied.append(name)
+        # The bank map must be consistent with the register banks it
+        # routes into — a stale/hand-edited manifest that references
+        # banks beyond the restored array would silently misroute
+        # every PFADD for those days. Fail loudly instead.
+        bank_vals = [int(b) for b in bank_of_raw.values()]
+        if bank_vals:
+            if len(set(bank_vals)) != len(bank_vals):
+                raise ValueError(
+                    "snapshot manifest maps two days to one HLL bank"
+                    " — manifest is corrupt")
+            if max(bank_vals) >= regs.shape[0]:
+                raise ValueError(
+                    f"snapshot manifest references bank "
+                    f"{max(bank_vals)} but only {regs.shape[0]} "
+                    "register banks were restored — manifest and "
+                    "registers are from different snapshots")
         if self.sharded:
             self.engine.set_state(bits, regs)
             self.engine.set_counts(counts)
@@ -1209,11 +1608,25 @@ class FusedPipeline:
                 self._step = make_jitted_step_bytes(
                     self.params, np.dtype(new_dtype).itemsize,
                     self.config.hll_precision)
-        self._bank_of = {int(d): b
-                         for d, b in manifest["bank_of"].items()}
+        self._bank_of = {int(d): b for d, b in bank_of_raw.items()}
         self._day_base = None
         self._day_lut.fill(-1)
         self._bloom_host = np.asarray(bits)
+        # Resume the delta chain where the restored manifest left it
+        # (stale skipped entries dropped — the next manifest write
+        # stops naming them): memory state now equals base + applied
+        # deltas, so new deltas append. The sequence counter also
+        # skips past crash-orphaned delta files (present on disk,
+        # absent from the manifest) so a new delta never overwrites
+        # one a concurrent post-mortem may read.
+        self._snap_chain = applied
+        self._dirty_days.clear()
+        self._regs_mirror = np.array(regs, dtype=np.uint8, copy=True)
+        self._base_stale = False
+        self._writer_base_ok = True
+        self._delta_seq = max(
+            (int(p.stem.split("-")[1])
+             for p in self._snap_dir.glob("delta-*.npz")), default=0)
         segs_dir = self._snap_dir / EVENTS_SEGMENTS
         events_path = self._snap_dir / EVENTS_SNAPSHOT
         if hasattr(self.store, "load_segments") and segs_dir.is_dir():
@@ -1229,20 +1642,54 @@ class FusedPipeline:
         elif events_path.exists():
             self.store.truncate()
             self.store.load(events_path)
-        logger.info("Restored snapshot: %d events, %d HLL banks",
-                    manifest["events"], len(self._bank_of))
+        logger.info("Restored snapshot: %d events (%d deltas), "
+                    "%d HLL banks", events, len(applied),
+                    len(self._bank_of))
         return True
 
     def _checkpoint_and_ack(self) -> None:
-        """Barrier: materialize all in-flight outputs, snapshot, then ack
-        — every acknowledged frame is durably in the snapshot."""
+        """Barrier: materialize all in-flight outputs, make them
+        durable, then ack — every acknowledged frame is durably in the
+        snapshot chain. The single-chip path routes through the async
+        writer (delta capture + flush); the mesh path stays in the
+        main thread because its state gathers contain collectives,
+        but in delta mode it gathers only the dirty banks."""
         for _, valid, _ in self._inflight:
             if valid is not None:
                 jax.block_until_ready(valid)
-        self.snapshot()
+        if not self.sharded:
+            self._checkpoint_async(force=True)  # acks when durable
+            self._flush_snapshots()
+            return
+        if self._snap_mode == "delta" and not self._base_stale:
+            self._snapshot_sync_delta()
+        else:
+            self.snapshot()
         acknowledge_all(self.consumer,
                         [m for m, _, _ in self._inflight])
         self._inflight.clear()
+
+    def _snapshot_sync_delta(self) -> None:
+        """Mesh-path incremental barrier: merge + gather ONLY the
+        dirty banks' register rows on device (one small D2H instead of
+        the full state), then write the delta synchronously. Gathers
+        run on EVERY process (collectives); only process 0 writes."""
+        self._flush_snapshots()
+        banks = self._drain_dirty_banks()
+        rows = self.engine.get_state_rows(
+            self._pad_bank_index(banks))[:len(banks)]
+        counts = self.engine.get_counts()
+        self._batches_at_snap = self.metrics.batches
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
+        with self._snap_io_lock:
+            nbytes = self._write_delta_files(
+                banks, rows, counts, dict(self._bank_of),
+                self.metrics.events, self.engine.num_banks, upto=None)
+        self._post_delta_bookkeeping(banks, rows, nbytes, counts,
+                                     dict(self._bank_of),
+                                     self.metrics.events,
+                                     self.engine.num_banks)
 
     # -- ack draining -------------------------------------------------------
     def _drain_inflight(self, block: int = 0) -> None:
@@ -1513,7 +1960,9 @@ class FusedPipeline:
     def cleanup(self) -> None:
         # Wait out any in-flight background snapshot before closing the
         # transport it would ack through (the write itself is already
-        # durable either way; this just keeps the acks clean).
+        # durable either way; this just keeps the acks clean), then
+        # shut the writer thread down.
         self._flush_snapshots()
+        self._stop_snap_writer()
         self.client.close()
         self.store.close()

@@ -206,6 +206,7 @@ class AttendanceProcessor:
             self.restore()
 
     SKETCH_SNAPSHOT = "processor_sketch.npz"
+    SKETCH_CHAIN = "processor_sketch_chain"
     EVENTS_SNAPSHOT = "processor_events.npz"
 
     @property
@@ -213,29 +214,65 @@ class AttendanceProcessor:
         return self._snap_dir is not None
 
     def snapshot(self) -> None:
-        """Persist sketch + store state to snapshot_dir (atomic files)."""
+        """Persist sketch + store state to snapshot_dir (atomic files).
+        With ``--snapshot-mode=delta`` the sketch side writes a
+        base+delta chain (only the keys written since the last
+        barrier; utils/snapshot.snapshot_sketch_store_chain) instead
+        of re-serializing every filter and register bank per
+        snapshot."""
         if self._snap_dir is None:
             return
-        from attendance_tpu.utils.snapshot import snapshot_sketch_store
         self._snap_dir.mkdir(parents=True, exist_ok=True)
         if hasattr(self.sketch, "_blooms"):  # redis keeps its own RDB/AOF
-            snapshot_sketch_store(self.sketch,
-                                  self._snap_dir / self.SKETCH_SNAPSHOT)
+            if (getattr(self.config, "snapshot_mode", "delta") == "delta"
+                    and hasattr(self.sketch, "drain_dirty")):
+                from attendance_tpu.utils.snapshot import (
+                    snapshot_sketch_store_chain)
+                snapshot_sketch_store_chain(
+                    self.sketch, self._snap_dir / self.SKETCH_CHAIN,
+                    compact_every=getattr(self.config,
+                                          "snapshot_compact_every", 16))
+            else:
+                from attendance_tpu.utils.snapshot import (
+                    snapshot_sketch_store)
+                snapshot_sketch_store(
+                    self.sketch, self._snap_dir / self.SKETCH_SNAPSHOT)
+                # A barrier-mode snapshot supersedes any delta chain a
+                # previous delta-mode run left in this dir: restore
+                # prefers the chain, so a stale manifest would shadow
+                # every event acked from here on. Unlink the manifest
+                # (orphan base/delta files are then ignored) and fsync
+                # the directory — the unlink IS the durability point
+                # here, so page-cache-only removal could resurrect the
+                # stale chain after a power loss.
+                stale = (self._snap_dir / self.SKETCH_CHAIN
+                         / "MANIFEST.json")
+                if stale.exists():
+                    from attendance_tpu.utils.snapshot import fsync_dir
+                    stale.unlink()
+                    fsync_dir(stale.parent)
         save = getattr(self.store, "save", None)
         if save is not None:
             save(self._snap_dir / self.EVENTS_SNAPSHOT)
         self._batches_at_snap = self.metrics.batches
 
     def restore(self) -> bool:
-        """Load the latest snapshot from snapshot_dir, if present."""
+        """Load the latest snapshot from snapshot_dir, if present (a
+        delta chain directory when one exists, else the legacy
+        one-shot npz)."""
         if self._snap_dir is None:
             return False
         restored = False
+        chain_dir = self._snap_dir / self.SKETCH_CHAIN
         sketch_path = self._snap_dir / self.SKETCH_SNAPSHOT
-        if sketch_path.exists() and hasattr(self.sketch, "_blooms"):
+        if hasattr(self.sketch, "_blooms"):
             from attendance_tpu.utils.snapshot import restore_sketch_store
-            restore_sketch_store(self.sketch, sketch_path)
-            restored = True
+            if (chain_dir / "MANIFEST.json").exists():
+                restore_sketch_store(self.sketch, chain_dir)
+                restored = True
+            elif sketch_path.exists():
+                restore_sketch_store(self.sketch, sketch_path)
+                restored = True
         events_path = self._snap_dir / self.EVENTS_SNAPSHOT
         load = getattr(self.store, "load", None)
         if events_path.exists() and load is not None:

@@ -153,6 +153,16 @@ class SketchStore(abc.ABC):
     def __init__(self, config):
         self.config = config
         self._blooms: Dict[str, ScalableBloom] = {}
+        # Dirty-key tracking for incremental (base+delta) snapshots
+        # (utils/snapshot.snapshot_sketch_store_chain): the PUBLIC
+        # command surface marks keys written since the last drain, so
+        # every backend routed through this dispatch (memory / tpu /
+        # redis-sim) tracks identically. _dirty_all forces the next
+        # chain snapshot to write a full base (fresh store, flush, or
+        # a restore mismatch).
+        self._dirty_blooms: set = set()
+        self._dirty_hll: set = set()
+        self._dirty_all = True
         # Accuracy auditor (obs/audit.py): captured ONCE here, one
         # `is not None` branch per public command when auditing is off
         # — the utils/profiling.py discipline. The hooks live on the
@@ -200,6 +210,7 @@ class SketchStore(abc.ABC):
         self._blooms[key] = ScalableBloom(
             self, int(capacity), float(error_rate),
             getattr(self.config, "bloom_layout", "flat"))
+        self._dirty_blooms.add(key)
         return True
 
     def _bloom_or_create(self, key: str) -> ScalableBloom:
@@ -213,6 +224,7 @@ class SketchStore(abc.ABC):
     def bf_add_many(self, key: str, members) -> np.ndarray:
         u32 = members_to_u32(members)
         out = self._bf_add_u32(key, u32)
+        self._dirty_blooms.add(key)
         if self._auditor is not None:
             self._auditor.record_bf_add(key, u32)
         return out
@@ -238,6 +250,7 @@ class SketchStore(abc.ABC):
 
     # -- HLL command surface ------------------------------------------------
     def pfadd(self, key: str, *members) -> int:
+        self._dirty_hll.add(key)
         if not members:
             return self._pf_create(key)
         u32 = members_to_u32(members)
@@ -249,6 +262,7 @@ class SketchStore(abc.ABC):
     def pfadd_many(self, key: str, members,
                    mask: Optional[np.ndarray] = None,
                    want_changed: bool = False) -> int:
+        self._dirty_hll.add(key)
         u32 = members_to_u32(members)
         out = self._pfadd_u32(key, u32, mask, want_changed)
         if self._auditor is not None:
@@ -363,9 +377,29 @@ class SketchStore(abc.ABC):
             return self.pfcount(*[str(k) for k in args[1:]])
         raise ResponseError(f"unknown command {cmd!r}")
 
+    # -- incremental-snapshot support ---------------------------------------
+    def drain_dirty(self):
+        """(dirty_all, bloom_keys, hll_keys) written since the last
+        drain, clearing the marks — the chain snapshotter's capture
+        point (utils/snapshot.snapshot_sketch_store_chain)."""
+        out = (self._dirty_all, self._dirty_blooms, self._dirty_hll)
+        self._dirty_all = False
+        self._dirty_blooms = set()
+        self._dirty_hll = set()
+        return out
+
+    def mark_clean(self) -> None:
+        """After restore: disk chain == memory state, nothing dirty."""
+        self._dirty_all = False
+        self._dirty_blooms.clear()
+        self._dirty_hll.clear()
+
     # -- lifecycle ----------------------------------------------------------
     def flush(self) -> None:
         self._blooms.clear()
+        self._dirty_all = True
+        self._dirty_blooms.clear()
+        self._dirty_hll.clear()
 
     def close(self) -> None:
         pass

@@ -377,6 +377,9 @@ class RedisSimSketchStore(SketchStore):
         if key in self._blooms:
             raise ResponseError("item exists")
         self._blooms[key] = _SimChain(int(capacity), float(error_rate))
+        # Structural write: incremental snapshots must carry this key
+        # (the base class marks its own bf_reserve the same way).
+        self._dirty_blooms.add(key)
         return True
 
     def _chain_or_create(self, key: str) -> _SimChain:

@@ -35,6 +35,7 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Dict, Optional, Tuple
 
 from attendance_tpu.transport.memory_broker import (
@@ -443,16 +444,32 @@ class SocketProducer:
 class SocketConsumer:
     """Consumer call-shape of MemoryConsumer over the socket protocol,
     including the zero-wrapper raw lane (the bridge feature-detects
-    receive_many_raw) and batch acks."""
+    receive_many_raw) and batch acks.
+
+    Single-message ``receive()`` — the fused pipeline's frame loop —
+    is PREFETCHED: one server round-trip pulls up to ``prefetch``
+    pending messages and the surplus is buffered client-side, so a
+    backlog of binary frames costs one RPC per ``prefetch`` frames
+    instead of one per frame (the per-frame round trip was the
+    socket-lane JSON probe's convergence ceiling — BENCH_r05
+    ``socket_json_converged: false``). Crash semantics are unchanged:
+    buffered messages are still in-flight AT THE SERVER, so a dropped
+    connection requeues them for the surviving competitors exactly
+    like un-received ones."""
+
+    PREFETCH = 16
 
     def __init__(self, rpc: _Rpc, handle: int, owns_rpc: bool = False,
                  owner: "Optional[SocketClient]" = None,
-                 topic: str = "", subscription: str = ""):
+                 topic: str = "", subscription: str = "",
+                 prefetch: int = PREFETCH):
         self._rpc = rpc
         self._handle = handle
         self._owns_rpc = owns_rpc
         self._owner = owner
         self._closed = False
+        self._prefetch = max(1, prefetch)
+        self._buffered: "deque" = deque()
         from attendance_tpu import obs
         tel = obs.get()
         if tel is not None:
@@ -520,6 +537,14 @@ class SocketConsumer:
 
     def receive_many_raw(self, max_n: int,
                          timeout_millis: Optional[int] = None) -> list:
+        # Serve (and fully drain, up to max_n) any prefetched messages
+        # first: a consumer mixing receive() with the batch lanes must
+        # never see buffered messages reordered behind later ones.
+        if self._buffered:
+            out = []
+            while self._buffered and len(out) < max_n:
+                out.append(self._buffered.popleft())
+            return out
         return self._receive_op(_OP_RECEIVE, max_n, timeout_millis)[1]
 
     def receive_chunk(self, max_n: int,
@@ -528,7 +553,19 @@ class SocketConsumer:
         """Chunk-lane batch receive over the wire: one server-side
         in-flight entry for the whole batch, settled with
         acknowledge_chunk / nack_chunk / explode_chunk — the bridge's
-        feature-detected fast lane works identically cross-process."""
+        feature-detected fast lane works identically cross-process.
+
+        Incompatible with single-message ``receive()`` on the SAME
+        consumer: prefetched messages cannot be folded into a chunk
+        handle, so serving the chunk lane past a non-empty buffer
+        would deliver out of order (or strand the buffered messages
+        until connection drop). No component mixes the lanes; fail
+        loudly if one starts to."""
+        if self._buffered:
+            raise RuntimeError(
+                "receive_chunk after receive() left prefetched "
+                "messages buffered — don't mix the chunk lane with "
+                "single-message receive on one consumer")
         return self._receive_op(_OP_RECEIVE_CHUNK, max_n, timeout_millis)
 
     def acknowledge_chunk(self, chunk_id: int) -> None:
@@ -550,7 +587,14 @@ class SocketConsumer:
                 in self.receive_many_raw(max_n, timeout_millis)]
 
     def receive(self, timeout_millis: Optional[int] = None) -> Message:
-        return self.receive_many(1, timeout_millis)[0]
+        """One message, served from the prefetch buffer when possible
+        (ONE round-trip per ``prefetch`` backlog messages — see the
+        class docstring)."""
+        if not self._buffered:
+            self._buffered.extend(self._receive_op(
+                _OP_RECEIVE, self._prefetch, timeout_millis)[1])
+        mid, data, red, props = self._buffered.popleft()
+        return Message(data, mid, red, props)
 
     def acknowledge_ids(self, message_ids) -> None:
         mids = list(message_ids)

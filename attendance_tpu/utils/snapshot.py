@@ -1,27 +1,165 @@
 """Sketch-state snapshot/restore (device -> host -> disk and back).
 
-Format: one ``.npz`` per snapshot holding every Bloom sub-filter's bit
-array, every HLL register bank, and a JSON manifest (bloom chain params,
-HLL name->bank map, counters). Writes are atomic (tmp file + rename) so a
-crash mid-snapshot never corrupts the last good one. Restoring into a
-fresh store then resuming from the broker cursor reproduces the
-reference's restart story (SURVEY.md §5): replayed events land in
-idempotent sinks, so at-least-once resume is lossless.
+Two on-disk layouts:
 
-Works for both host-side (memory) and device-side (tpu) stores: state is
-pulled with np.asarray (device->host copy for jax arrays, no-op for
+* **One-shot npz** (:func:`snapshot_sketch_store` /
+  :func:`restore_sketch_store` with a file path): one ``.npz`` holding
+  every Bloom sub-filter's bit array, every HLL register bank, and a
+  JSON manifest (bloom chain params, HLL name->bank map). Writes are
+  atomic (tmp file + rename) so a crash mid-snapshot never corrupts
+  the last good one.
+* **Base+delta chain** (:func:`snapshot_sketch_store_chain` /
+  :func:`restore_sketch_store` with a directory): a full base npz plus
+  ``delta-NNNN.npz`` files carrying ONLY the keys written since the
+  previous snapshot (the store's dirty-key sets, fed by the public
+  command surface — sketch/base.py), chained by an fsync'd
+  ``MANIFEST.json`` whose atomic rename is the durability point: a
+  delta file a crash orphaned before its manifest entry is ignored on
+  restore. Every ``compact_every`` deltas the chain folds back into a
+  fresh full base and the superseded files are deleted.
+
+Restoring into a fresh store then resuming from the broker cursor
+reproduces the reference's restart story (SURVEY.md §5): replayed
+events land in idempotent sinks, so at-least-once resume is lossless.
+
+Works for both host-side (memory) and device-side (tpu) stores: state
+is pulled with np.asarray (device->host copy for jax arrays, no-op for
 numpy) and pushed back with the store's native array type.
 """
 
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
 from typing import Dict
 
 import numpy as np
 
 from attendance_tpu.models.bloom import BloomParams
+
+CHAIN_MANIFEST = "MANIFEST.json"
+
+
+def _bloom_manifest_entry(chain, key: str, arrays: Dict, tag: str) -> Dict:
+    """Serialize one ScalableBloom chain into ``arrays`` (under
+    ``{tag}/{key}/{i}`` names) and return its manifest entry — shared
+    by the full base and the per-dirty-key delta writers."""
+    filters = []
+    for i, (handle, params) in enumerate(zip(chain.filters,
+                                             chain.params)):
+        name = f"{tag}/{key}/{i}"
+        arrays[name] = np.asarray(handle)
+        filters.append({"array": name, "params": list(params[:2]) + [
+            params.layout, params.capacity, params.error_rate]})
+    return {
+        "base_capacity": chain.base_capacity,
+        "base_error": chain.base_error,
+        "layout": chain.layout,
+        "counts": chain.counts,
+        "filters": filters,
+    }
+
+
+def _restore_bloom_key(store, key: str, info: Dict, data) -> None:
+    """Rebuild one key's ScalableBloom chain from a manifest entry —
+    shared by the one-shot restore and the delta apply."""
+    from attendance_tpu.sketch.base import ScalableBloom
+
+    chain = ScalableBloom.__new__(ScalableBloom)
+    chain.store = store
+    chain.base_capacity = info["base_capacity"]
+    chain.base_error = info["base_error"]
+    chain.layout = info["layout"]
+    chain.counts = list(info["counts"])
+    chain.filters, chain.params = [], []
+    for finfo in info["filters"]:
+        m_bits, k, layout, capacity, error_rate = finfo["params"]
+        params = BloomParams(int(m_bits), int(k), layout,
+                             int(capacity), float(error_rate))
+        bits = data[finfo["array"]]
+        chain.params.append(params)
+        chain.filters.append(store._restore_filter(params, bits))
+    store._blooms[key] = chain
+
+
+def _hll_row(store, key: str):
+    """Host copy of one key's HLL registers, or None when the key has
+    none — the per-key granularity deltas are written at, working for
+    the banked (tpu), per-key-dict (memory), and redis-sim layouts."""
+    hll = getattr(store, "_hll", None)
+    if hll is not None:  # TpuSketchStore: banked device array
+        bank = hll.bank_index(key, create=False)
+        if bank < 0:
+            return None
+        return np.asarray(hll.regs[bank])
+    regs = getattr(store, "_hll_regs", None)
+    if regs is None:
+        regs = getattr(store, "_hlls", {})
+    row = regs.get(key)
+    return None if row is None else np.asarray(row)
+
+
+def _apply_hll_row(store, key: str, row: np.ndarray) -> None:
+    hll = getattr(store, "_hll", None)
+    if hll is not None:
+        import jax.numpy as jnp
+
+        bank = hll.bank_index(key)  # creates/grows the bank
+        hll.regs = hll.regs.at[bank].set(
+            jnp.asarray(np.asarray(row, dtype=np.uint8)))
+        return
+    regs = getattr(store, "_hll_regs", None)
+    if regs is None:
+        regs = getattr(store, "_hlls", None)
+    regs[key] = np.array(row, dtype=np.uint8)
+
+
+def _hll_precision(store) -> int:
+    hll = getattr(store, "_hll", None)
+    if hll is not None:
+        return hll.precision
+    return getattr(store, "precision", 14)
+
+
+def fsync_write_npz(path, arrays: Dict) -> None:
+    """Durably publish one npz: tmp write + fsync + atomic rename.
+    THE definition of the delta-file write for both chain layers (the
+    fused pipeline's dirty-bank deltas and the generic store chain)."""
+    path = Path(path)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    tmp.replace(path)
+
+
+def fsync_dir(dir_path) -> None:
+    """fsync a DIRECTORY: renames/unlinks inside it are durable only
+    once the directory entry itself is — required wherever such an
+    operation is a chain's durability point."""
+    dir_fd = os.open(Path(dir_path), os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+
+
+def write_manifest_atomic(dir_path, doc: Dict,
+                          name: str = CHAIN_MANIFEST) -> None:
+    """tmp + fsync + rename + directory fsync: the rename IS a chain
+    snapshot's durability point. Shared by both chain layers (the
+    fused pipeline names its manifest CHAIN.json)."""
+    dir_path = Path(dir_path)
+    path = dir_path / name
+    tmp = path.with_suffix(".tmp")
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    tmp.replace(path)
+    fsync_dir(dir_path)
 
 
 def snapshot_sketch_store(store, path) -> Dict:
@@ -32,20 +170,8 @@ def snapshot_sketch_store(store, path) -> Dict:
     manifest: Dict = {"blooms": {}, "hll": {}}
 
     for key, chain in store._blooms.items():
-        filters = []
-        for i, (handle, params) in enumerate(zip(chain.filters,
-                                                 chain.params)):
-            name = f"bloom/{key}/{i}"
-            arrays[name] = np.asarray(handle)
-            filters.append({"array": name, "params": list(params[:2]) + [
-                params.layout, params.capacity, params.error_rate]})
-        manifest["blooms"][key] = {
-            "base_capacity": chain.base_capacity,
-            "base_error": chain.base_error,
-            "layout": chain.layout,
-            "counts": chain.counts,
-            "filters": filters,
-        }
+        manifest["blooms"][key] = _bloom_manifest_entry(
+            chain, key, arrays, "bloom")
 
     hll = getattr(store, "_hll", None)
     if hll is not None:  # TpuSketchStore: one banked array + name map
@@ -67,43 +193,130 @@ def snapshot_sketch_store(store, path) -> Dict:
     tmp = path.with_suffix(path.suffix + ".tmp")
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)
+        # fsync before rename: chain snapshots delete superseded files
+        # once a new base is published, so page-cache durability is
+        # not enough for the base itself.
+        f.flush()
+        os.fsync(f.fileno())
     tmp.replace(path)
     return manifest
 
 
-def restore_sketch_store(store, path) -> None:
-    """Load a snapshot into a freshly constructed store (same backend)."""
-    from attendance_tpu.sketch.base import ScalableBloom
+def snapshot_sketch_store_chain(store, dir_path,
+                                compact_every: int = 16) -> Dict:
+    """Incremental snapshot of a generic SketchStore into ``dir_path``.
 
+    Writes a full base when the chain needs one (fresh directory, a
+    structural reset like flush, or ``compact_every`` deltas
+    accumulated — the compaction fold), otherwise one
+    ``delta-NNNN.npz`` carrying ONLY the keys written since the last
+    snapshot (the store's drained dirty sets). Either way the fsync'd
+    ``MANIFEST.json`` rename is the durability point; callers may
+    treat its return as "state up to here is durable" (the processor's
+    group-commit ack barrier). Returns the published manifest."""
+    dir_path = Path(dir_path)
+    dir_path.mkdir(parents=True, exist_ok=True)
+    dirty_all, dirty_blooms, dirty_hll = store.drain_dirty()
+    try:
+        manifest_path = dir_path / CHAIN_MANIFEST
+        chain = (json.loads(manifest_path.read_text())
+                 if manifest_path.exists() else None)
+        seq = (chain["seq"] if chain else 0) + 1
+        if (dirty_all or chain is None
+                or len(chain.get("deltas", ())) + 1 >= compact_every):
+            base = f"base-{seq:04d}.npz"
+            snapshot_sketch_store(store, dir_path / base)
+            doc = {"seq": seq, "base": base, "deltas": []}
+            write_manifest_atomic(dir_path, doc)
+            _gc_chain_files(dir_path, keep={base})
+            return doc
+        name = f"delta-{seq:04d}.npz"
+        arrays: Dict[str, np.ndarray] = {}
+        manifest: Dict = {"blooms": {}, "hll": {}}
+        for key in sorted(dirty_blooms):
+            bloom = store._blooms.get(key)
+            if bloom is not None:
+                manifest["blooms"][key] = _bloom_manifest_entry(
+                    bloom, key, arrays, "bloom")
+        keys = []
+        for key in sorted(dirty_hll):
+            row = _hll_row(store, key)
+            if row is not None:
+                arrays[f"hll/{len(keys)}"] = row
+                keys.append(key)
+        manifest["hll"] = {"kind": "rows", "keys": keys,
+                           "precision": _hll_precision(store)}
+        arrays["__manifest__"] = np.frombuffer(
+            json.dumps(manifest).encode(), dtype=np.uint8)
+        fsync_write_npz(dir_path / name, arrays)
+        chain["seq"] = seq
+        chain["deltas"].append(name)
+        write_manifest_atomic(dir_path, chain)
+        return chain
+    except Exception:
+        # The drained dirty marks describe writes that never became
+        # durable — a caller retrying the barrier (the processor's
+        # consume loop, still holding its unacked messages) would
+        # otherwise publish an EMPTY delta and ack events whose sketch
+        # updates reached no snapshot. Restore the marks and force the
+        # next attempt to write a full base (the disk state is
+        # uncertain), mirroring the fused writer's self-heal.
+        store._dirty_all = True
+        store._dirty_blooms |= dirty_blooms
+        store._dirty_hll |= dirty_hll
+        raise
+
+
+def _gc_chain_files(dir_path: Path, keep: set) -> None:
+    """Delete superseded base/delta files AFTER the manifest that
+    stopped referencing them became durable."""
+    for p in list(dir_path.glob("base-*.npz")) + \
+            list(dir_path.glob("delta-*.npz")):
+        if p.name not in keep:
+            try:
+                p.unlink()
+            except OSError:
+                pass
+
+
+def _apply_sketch_delta(store, path) -> None:
+    """Fold one delta file into a restored store: replace the chains
+    of the bloom keys it names, overwrite the register rows of the HLL
+    keys it names."""
     with np.load(Path(path)) as data:
         manifest = json.loads(bytes(data["__manifest__"]).decode())
-
-        store._blooms.clear()
         for key, info in manifest["blooms"].items():
-            chain = ScalableBloom.__new__(ScalableBloom)
-            chain.store = store
-            chain.base_capacity = info["base_capacity"]
-            chain.base_error = info["base_error"]
-            chain.layout = info["layout"]
-            chain.counts = list(info["counts"])
-            chain.filters, chain.params = [], []
-            for finfo in info["filters"]:
-                m_bits, k, layout, capacity, error_rate = finfo["params"]
-                params = BloomParams(int(m_bits), int(k), layout,
-                                     int(capacity), float(error_rate))
-                bits = data[finfo["array"]]
-                chain.params.append(params)
-                chain.filters.append(store._restore_filter(params, bits))
-            store._blooms[key] = chain
-
+            _restore_bloom_key(store, key, info, data)
         hinfo = manifest["hll"]
-        if hinfo.get("kind") == "banked":
-            store._restore_hll_banked(data["hll/regs"], hinfo["bank_of"],
-                                      hinfo["precision"])
-        elif hinfo.get("kind") == "per_key":
-            regs = {key: data[f"hll/{i}"]
-                    for i, key in enumerate(hinfo["keys"])}
-            store._restore_hll_per_key(regs, hinfo["precision"])
+        for i, key in enumerate(hinfo.get("keys", ())):
+            _apply_hll_row(store, key, data[f"hll/{i}"])
+
+
+def restore_sketch_store(store, path) -> None:
+    """Load a snapshot into a freshly constructed store (same backend).
+
+    ``path`` may be a one-shot npz file, or a chain DIRECTORY written
+    by :func:`snapshot_sketch_store_chain` — then the manifest's base
+    loads first and every listed delta is applied in order (delta
+    files the manifest does not name are crash orphans and ignored).
+    """
+    p = Path(path)
+    if p.is_dir():
+        manifest = json.loads((p / CHAIN_MANIFEST).read_text())
+        _restore_npz(store, p / manifest["base"])
+        for name in manifest.get("deltas", ()):
+            dpath = p / name
+            if not dpath.exists():
+                raise ValueError(
+                    f"chain manifest names {name} but the delta file "
+                    "is missing — snapshot directory is corrupt")
+            _apply_sketch_delta(store, dpath)
+    else:
+        _restore_npz(store, p)
+    if hasattr(store, "mark_clean"):
+        # Disk now equals memory: the next chain snapshot appends a
+        # delta of genuinely-new writes instead of a spurious base.
+        store.mark_clean()
 
     # Restore REPLACES the store's filter handles and HLL registers —
     # any weakref'd health gauge registered against the previous
@@ -113,3 +326,21 @@ def restore_sketch_store(store, path) -> None:
     # was never registered or telemetry is down).
     from attendance_tpu.obs.health import reregister_store
     reregister_store(store)
+
+
+def _restore_npz(store, path) -> None:
+    with np.load(Path(path)) as data:
+        manifest = json.loads(bytes(data["__manifest__"]).decode())
+
+        store._blooms.clear()
+        for key, info in manifest["blooms"].items():
+            _restore_bloom_key(store, key, info, data)
+
+        hinfo = manifest["hll"]
+        if hinfo.get("kind") == "banked":
+            store._restore_hll_banked(data["hll/regs"], hinfo["bank_of"],
+                                      hinfo["precision"])
+        elif hinfo.get("kind") == "per_key":
+            regs = {key: data[f"hll/{i}"]
+                    for i, key in enumerate(hinfo["keys"])}
+            store._restore_hll_per_key(regs, hinfo["precision"])

@@ -1,0 +1,385 @@
+"""Crash consistency of the incremental (base+delta) snapshot chain.
+
+The durability point of a delta snapshot is the fsync'd CHAIN.json /
+MANIFEST.json rename — a crash BETWEEN the delta file write and that
+rename must leave a restorable directory whose state equals the last
+COMPLETE manifest, with every acked event still present (frames of the
+orphaned delta were never acked, so the broker redelivers them and the
+idempotent sinks absorb the replay). Covered for the fused pipeline
+(tpu-path state) and the generic SketchStore chain (memory + tpu
+backends), plus chain compaction and bank growth across a delta
+boundary.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from attendance_tpu.config import Config
+from attendance_tpu.pipeline.fast_path import CHAIN_MANIFEST, FusedPipeline
+from attendance_tpu.pipeline.loadgen import generate_frames
+from attendance_tpu.transport.memory_broker import MemoryBroker, MemoryClient
+
+NUM_EVENTS, BATCH = 16_384, 2_048
+
+
+def _mkframes(seed=61):
+    return generate_frames(NUM_EVENTS, BATCH, roster_size=6_000,
+                           num_lectures=6, invalid_fraction=0.15,
+                           seed=seed)
+
+
+def _mkcfg(snap_dir="", every=2, **kw):
+    return Config(bloom_filter_capacity=20_000,
+                  transport_backend="memory",
+                  snapshot_dir=snap_dir,
+                  snapshot_every_batches=every if snap_dir else 0, **kw)
+
+
+def _state(pipe):
+    df = pipe.store.to_dataframe().sort_values(
+        ["lecture_day", "micros", "student_id"]).reset_index(drop=True)
+    return df, {day: pipe.count(day) for day in pipe.lecture_days()}
+
+
+def test_orphaned_delta_is_ignored_on_restore(tmp_path):
+    """A delta file on disk that no manifest rename ever published is
+    exactly what a crash between the two writes leaves behind; restore
+    must not apply it (poisoned registers prove it never loads)."""
+    roster, frames = _mkframes()
+    frames = list(frames)
+    snap = tmp_path / "snaps"
+    config = _mkcfg(str(snap))
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    chain = json.loads((snap / CHAIN_MANIFEST).read_text())
+    assert chain["deltas"], "delta mode should write incremental files"
+    expect = {day: pipe.count(day) for day in pipe.lecture_days()}
+
+    # Saturated-rank registers for every bank: if restore applied this
+    # orphan, every PFCOUNT would explode.
+    poison = {
+        "bank_idx": np.arange(8, dtype=np.int32),
+        "regs_rows": np.full((8, 1 << 14), 31, np.uint8),
+        "counts": np.zeros((2, 2), np.uint32),
+        "manifest": np.frombuffer(json.dumps(
+            {"bank_of": {str(d): b for d, b in pipe._bank_of.items()},
+             "events": 10 ** 9, "num_banks": 8}).encode(), np.uint8),
+    }
+    with open(snap / "delta-9999.npz", "wb") as f:
+        np.savez(f, **poison)
+
+    pipe2 = FusedPipeline(config, client=MemoryClient(MemoryBroker()),
+                          num_banks=8)
+    assert {day: pipe2.count(day) for day in pipe2.lecture_days()} \
+        == expect
+    assert tuple(pipe2.validity_counts()) == \
+        tuple(pipe.validity_counts())
+    # ... and the next barrier's sequence number skips past the orphan
+    # instead of overwriting it.
+    assert pipe2._delta_seq == 9999
+
+
+def test_writer_crash_before_manifest_rename_loses_nothing(tmp_path):
+    """Kill the writer between the delta file and the manifest rename:
+    the restored pipeline equals the last COMPLETE manifest, and
+    draining the redelivered (never-acked) frames lands exactly on the
+    uninterrupted oracle — no acked event lost, no event double-counted."""
+    roster, frames = _mkframes(seed=67)
+    frames = list(frames)
+
+    client = MemoryClient(MemoryBroker())
+    ref = FusedPipeline(_mkcfg(), client=client, num_banks=8)
+    ref.preload(roster)
+    producer = client.create_producer(ref.config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    ref.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    ref_df, ref_counts = _state(ref)
+
+    snap = tmp_path / "snaps"
+    config = _mkcfg(str(snap))
+    broker = MemoryBroker()
+    a = FusedPipeline(config, client=MemoryClient(broker), num_banks=8)
+    calls = {"n": 0}
+    orig = a._write_chain_manifest
+
+    def crashing_manifest():
+        calls["n"] += 1
+        if calls["n"] >= 3:  # base + 1 delta survive; then "power cut"
+            raise OSError("simulated crash before manifest rename")
+        orig()
+
+    a._write_chain_manifest = crashing_manifest
+    a.preload(roster)
+    producer = a.client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    a.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    a.consumer.close()  # crash: every unacked frame redelivers
+
+    # On disk: the chain ends at the last complete manifest; at least
+    # one orphaned delta file exists past it.
+    chain = json.loads((snap / CHAIN_MANIFEST).read_text())
+    on_disk = {p.name for p in snap.glob("delta-*.npz")}
+    assert set(chain["deltas"]) < on_disk
+
+    b = FusedPipeline(config, client=MemoryClient(broker), num_banks=8)
+    # The restored sketch equals the last complete manifest exactly:
+    # its counters add up to the events that barrier covered.
+    if chain["deltas"]:
+        with np.load(snap / chain["deltas"][-1]) as d:
+            events_at = json.loads(
+                bytes(d["manifest"]).decode())["events"]
+    else:
+        with np.load(snap / chain["base"]) as d:
+            events_at = json.loads(
+                bytes(d["manifest"]).decode())["events"]
+    v, i = b.validity_counts()
+    assert v + i == events_at
+    assert events_at < NUM_EVENTS  # the crash genuinely cut the run
+
+    b.run(idle_timeout_s=0.5)
+    assert b.consumer.backlog() == 0
+    got_df, got_counts = _state(b)
+    assert got_counts == ref_counts
+    assert len(got_df) == len(ref_df)
+    for col in ("student_id", "lecture_day", "micros", "is_valid"):
+        np.testing.assert_array_equal(got_df[col].to_numpy(),
+                                      ref_df[col].to_numpy())
+
+
+def test_failed_base_write_fails_queued_deltas_and_self_heals(tmp_path):
+    """A failed BASE write must also fail any delta already staged
+    behind it (never chain a delta onto a stale on-disk base and ack
+    its frames); the next barrier writes a fresh base and the run
+    self-heals — a final restore equals the uninterrupted oracle."""
+    roster, frames = _mkframes(seed=79)
+    frames = list(frames)
+
+    client = MemoryClient(MemoryBroker())
+    ref = FusedPipeline(_mkcfg(), client=client, num_banks=8)
+    ref.preload(roster)
+    producer = client.create_producer(ref.config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    ref.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    ref_df, ref_counts = _state(ref)
+
+    snap = tmp_path / "snaps"
+    config = _mkcfg(str(snap))
+    broker = MemoryBroker()
+    a = FusedPipeline(config, client=MemoryClient(broker), num_banks=8)
+    orig = a._write_snapshot_files
+    calls = {"n": 0}
+
+    def failing_base(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:  # the run's FIRST base write dies
+            raise OSError("simulated base write failure")
+        return orig(*args, **kwargs)
+
+    a._write_snapshot_files = failing_base
+    a.preload(roster)
+    producer = a.client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    a.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    assert calls["n"] >= 2  # the writer retried a full base
+    a.consumer.close()  # requeue whatever never became durable
+
+    b = FusedPipeline(config, client=MemoryClient(broker), num_banks=8)
+    b.run(idle_timeout_s=0.5)
+    assert b.consumer.backlog() == 0
+    got_df, got_counts = _state(b)
+    assert got_counts == ref_counts
+    assert len(got_df) == len(ref_df)
+
+
+def test_chain_compaction_folds_into_base(tmp_path):
+    """Every snapshot_compact_every deltas the writer folds the chain
+    into a fresh full base and deletes the superseded files; restore
+    from the compacted dir equals the live pipeline."""
+    roster, frames = _mkframes(seed=71)
+    frames = list(frames)
+    snap = tmp_path / "snaps"
+    config = _mkcfg(str(snap), every=1, snapshot_compact_every=3)
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        # One frame per run: every run-end barrier flushes, so exactly
+        # one durable write per frame (deterministic chain growth).
+        producer.send(f)
+        pipe.run(max_events=BATCH, idle_timeout_s=0.3)
+    chain = json.loads((snap / CHAIN_MANIFEST).read_text())
+    assert pipe._delta_seq >= 3  # enough deltas to trigger a fold
+    assert len(chain["deltas"]) < 3  # ... and the fold happened
+    # Superseded files are gone: disk holds exactly the live chain.
+    assert {p.name for p in snap.glob("delta-*.npz")} \
+        == set(chain["deltas"])
+
+    pipe2 = FusedPipeline(config, client=MemoryClient(MemoryBroker()),
+                          num_banks=8)
+    a_df, a_counts = _state(pipe)
+    b_df, b_counts = _state(pipe2)
+    assert a_counts == b_counts
+    assert len(a_df) == len(b_df)
+    assert tuple(pipe2.validity_counts()) == \
+        tuple(pipe.validity_counts())
+
+
+def test_stale_deltas_after_base_replace_crash_are_skipped(tmp_path):
+    """The one crash window the in-place base replace opens: a new
+    fused_sketch.npz lands but the crash hits before CHAIN.json is
+    reset, so the manifest still names deltas OLDER than the base.
+    Restore must skip them (their events counter is <= the base's) —
+    applying them would regress registers and shear the bank map off
+    the register banks."""
+    roster, frames = _mkframes(seed=83)
+    frames = list(frames)
+    snap = tmp_path / "snaps"
+    config = _mkcfg(str(snap))
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    assert json.loads((snap / CHAIN_MANIFEST).read_text())["deltas"]
+    expect_counts = {d: pipe.count(d) for d in pipe.lecture_days()}
+    expect_vc = tuple(pipe.validity_counts())
+
+    # Full snapshot whose manifest reset "crashes": the base file is
+    # replaced, the old delta list survives on disk.
+    def crash(*a, **kw):
+        raise OSError("simulated crash before chain-manifest reset")
+
+    pipe._write_chain_manifest = crash
+    with pytest.raises(OSError):
+        pipe.snapshot()
+    assert json.loads((snap / CHAIN_MANIFEST).read_text())["deltas"]
+
+    pipe2 = FusedPipeline(config, client=MemoryClient(MemoryBroker()),
+                          num_banks=8)
+    assert pipe2._snap_chain == []  # stale entries dropped
+    assert {d: pipe2.count(d) for d in pipe2.lecture_days()} \
+        == expect_counts
+    assert tuple(pipe2.validity_counts()) == expect_vc
+
+
+def test_delta_restores_across_bank_growth(tmp_path):
+    """Bank growth between two barriers rides the delta (num_banks in
+    its manifest): restore grows the register array before applying
+    rows instead of dropping high banks."""
+    from attendance_tpu.pipeline.events import encode_planar_batch
+
+    config = Config(bloom_filter_capacity=4_096,
+                    snapshot_dir=str(tmp_path / "snap"),
+                    snapshot_every_batches=1)
+    client = MemoryClient(MemoryBroker())
+    a = FusedPipeline(config, client=client, num_banks=4)
+    roster = np.arange(10_000, 12_000, dtype=np.uint32)
+    a.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+
+    def frame(days):
+        n = len(days)
+        cols = {
+            "student_id": np.resize(roster[:4], n).astype(np.uint32),
+            "lecture_day": np.asarray(days, np.uint32),
+            "micros": 1_000_000 + np.arange(n, dtype=np.int64),
+            "is_valid": np.ones(n, bool),
+            "event_type": np.zeros(n, np.int8),
+        }
+        return encode_planar_batch(cols)
+
+    producer.send(frame([20260101, 20260102]))
+    a.run(max_events=2, idle_timeout_s=0.2)  # barrier -> full base
+    days2 = [20260110 + i for i in range(12)]  # growth: 4 -> 16 banks
+    producer.send(frame(days2))
+    a.run(max_events=12, idle_timeout_s=0.2)  # barrier -> delta
+    a.cleanup()
+    counts = {d: a.count(d) for d in a.lecture_days()}
+    assert a.state.hll_regs.shape[0] > 4
+
+    b = FusedPipeline(config, client=MemoryClient(MemoryBroker()),
+                      num_banks=4)
+    assert b.state.hll_regs.shape[0] >= a.state.hll_regs.shape[0] or \
+        b.state.hll_regs.shape[0] > 4
+    assert {d: b.count(d) for d in b.lecture_days()} == counts
+
+
+@pytest.mark.parametrize("backend", ["memory", "tpu"])
+def test_store_chain_crash_consistency_and_health_gauges(
+        tmp_path, backend, monkeypatch):
+    """Generic SketchStore chain: crash between the delta file and the
+    manifest rename restores to the last complete manifest (memory AND
+    tpu backends), and the restored store still reports its health
+    gauges at scrape time (restore-then-scrape, PR 3 contract)."""
+    import attendance_tpu.utils.snapshot as snap_mod
+    from attendance_tpu import obs
+    from attendance_tpu.sketch import make_sketch_store
+    from attendance_tpu.utils.snapshot import (
+        restore_sketch_store, snapshot_sketch_store_chain)
+
+    obs.disable()
+    cfg = Config(sketch_backend=backend, metrics_port=-1)
+    t = obs.enable(cfg)
+    try:
+        store = make_sketch_store(cfg)
+        store.bf_add_many(cfg.bloom_filter_key,
+                          np.arange(2_000, dtype=np.int64))
+        key = f"{cfg.hll_key_prefix}LECTURE_1"
+        store.pfadd_many(key, np.arange(1_000, dtype=np.int64))
+        chain_dir = tmp_path / "chain"
+        snapshot_sketch_store_chain(store, chain_dir)  # base
+        store.pfadd_many(key, np.arange(1_000, 1_500, dtype=np.int64))
+        snapshot_sketch_store_chain(store, chain_dir)  # durable delta
+        count_at_manifest = store.pfcount(key)
+
+        store.pfadd_many(key, np.arange(1_500, 4_000, dtype=np.int64))
+        real = snap_mod.write_manifest_atomic
+
+        def boom(dir_path, doc, name=snap_mod.CHAIN_MANIFEST):
+            raise OSError("simulated crash before manifest rename")
+
+        monkeypatch.setattr(snap_mod, "write_manifest_atomic", boom)
+        with pytest.raises(OSError):
+            snapshot_sketch_store_chain(store, chain_dir)
+        monkeypatch.setattr(snap_mod, "write_manifest_atomic", real)
+
+        # The orphaned delta file exists but the manifest never named
+        # it: restore lands on the last complete manifest.
+        manifest = json.loads(
+            (chain_dir / "MANIFEST.json").read_text())
+        assert {p.name for p in chain_dir.glob("delta-*.npz")} \
+            > set(manifest["deltas"])
+        restored = make_sketch_store(cfg)
+        restore_sketch_store(restored, chain_dir)
+        assert restored.pfcount(key) == count_at_manifest
+        probe = np.arange(0, 4_000, dtype=np.int64)
+        np.testing.assert_array_equal(
+            np.asarray(restored.bf_exists_many(cfg.bloom_filter_key,
+                                               probe)),
+            np.asarray(store.bf_exists_many(cfg.bloom_filter_key,
+                                            probe)))
+
+        # Restore-then-scrape: the replaced innards did not strand the
+        # weakref'd health gauges.
+        del store
+        g = t.registry.gauge("attendance_hll_estimate",
+                             backend=backend)
+        assert g.value > 0
+        assert f'attendance_bloom_fill_fraction{{backend="{backend}"}}' \
+            in t.render()
+    finally:
+        obs.disable()

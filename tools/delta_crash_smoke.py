@@ -1,0 +1,204 @@
+"""Cross-process delta-snapshot crash-recovery smoke (CI gate).
+
+Drives the whole durability story with a REAL ``SIGKILL``, no mocks:
+
+1. start a socket broker subprocess and publish a deterministic binary
+   backlog;
+2. spawn a worker process running the fused pipeline with
+   ``--snapshot-mode=delta`` (plus live telemetry artifacts);
+3. SIGKILL the worker once its snapshot chain holds at least one delta;
+4. restore a fresh pipeline from the snapshot dir, drain the frames the
+   broker requeued (crash takeover), and compare the final state
+   against an uninterrupted in-process oracle over the same frames;
+5. replay the worker's telemetry artifacts through ``doctor`` with a
+   snapshot-stall ceiling.
+
+Exit 0 = recovery lossless and doctor passed; anything else fails CI.
+Run on CPU: ``JAX_PLATFORMS=cpu python tools/delta_crash_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+NUM_EVENTS, BATCH = 65_536, 2_048
+SEED = 83
+
+
+def _frames():
+    from attendance_tpu.pipeline.loadgen import generate_frames
+
+    return generate_frames(NUM_EVENTS, BATCH, roster_size=10_000,
+                           num_lectures=8, invalid_fraction=0.1,
+                           seed=SEED)
+
+
+def worker_main(args) -> None:
+    """The to-be-killed half: consume from the broker with delta
+    checkpointing + telemetry until the parent SIGKILLs us."""
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.transport.socket_broker import SocketClient
+
+    config = Config(bloom_filter_capacity=50_000,
+                    transport_backend="socket",
+                    socket_broker=args.broker,
+                    snapshot_dir=args.snapshot_dir,
+                    snapshot_mode="delta",
+                    snapshot_every_batches=2,
+                    metrics_prom=args.metrics_prom,
+                    metrics_interval_s=0.2,
+                    alert_log=args.alert_log)
+    roster, _ = _frames()
+    pipe = FusedPipeline(config, client=SocketClient(args.broker),
+                         num_banks=8)
+    pipe.preload(roster)
+    print("worker ready", flush=True)
+    pipe.run(idle_timeout_s=60.0)  # parent kills us mid-stream
+
+
+def _state(pipe) -> dict:
+    counts = {int(d): pipe.count(int(d)) for d in pipe.lecture_days()}
+    df = pipe.store.to_dataframe()
+    return {"counts": counts, "rows": len(df),
+            "valid": int(df.is_valid.sum())}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", default="/tmp/delta_crash_smoke")
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--broker", default="")
+    ap.add_argument("--snapshot-dir", default="")
+    ap.add_argument("--metrics-prom", default="")
+    ap.add_argument("--alert-log", default="")
+    ap.add_argument("--stall-ceiling", type=float, default=5.0,
+                    help="doctor snapshot-stall p99 gate (generous: "
+                    "shared CI runners)")
+    args = ap.parse_args()
+    if args.worker:
+        worker_main(args)
+        return 0
+
+    work = Path(args.workdir)
+    work.mkdir(parents=True, exist_ok=True)
+    snap = work / "snaps"
+    prom = work / "metrics.prom"
+    alerts = work / "alerts.jsonl"
+
+    broker_proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "attendance_tpu.transport.socket_broker", "--port", "0"],
+        stdout=subprocess.PIPE, text=True, cwd=str(REPO))
+    addr = broker_proc.stdout.readline().strip().rsplit(" ", 1)[-1]
+    worker = None
+    try:
+        worker = subprocess.Popen(
+            [sys.executable, str(Path(__file__).resolve()), "--worker",
+             "--broker", addr, "--snapshot-dir", str(snap),
+             "--metrics-prom", str(prom), "--alert-log", str(alerts)],
+            stdout=subprocess.PIPE, text=True, cwd=str(REPO))
+        assert worker.stdout.readline().strip() == "worker ready", \
+            "worker failed to start"
+
+        from attendance_tpu.transport.socket_broker import SocketClient
+
+        roster, frames = _frames()
+        frames = list(frames)
+        client = SocketClient(addr)
+        producer = client.create_producer("attendance-events")
+        for f in frames:
+            producer.send(f)
+
+        # Kill the worker the moment its chain holds a delta (mid-run
+        # by construction: acks lag the barriers, so whatever is not
+        # yet durable redelivers below).
+        chain_path = snap / "CHAIN.json"
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if chain_path.exists() and json.loads(
+                    chain_path.read_text()).get("deltas"):
+                break
+            if worker.poll() is not None:
+                print("FAIL: worker exited before the kill")
+                return 1
+            time.sleep(0.02)
+        else:
+            print("FAIL: no delta snapshot within 120s")
+            return 1
+        worker.send_signal(signal.SIGKILL)
+        worker.wait()
+        print(f"killed worker mid-run; chain: "
+              f"{json.loads(chain_path.read_text())}", flush=True)
+
+        # Recover: restore + drain the requeued frames. The broker's
+        # crash takeover requeues everything unacked when the killed
+        # worker's connection dropped.
+        from attendance_tpu.config import Config
+        from attendance_tpu.pipeline.fast_path import FusedPipeline
+
+        config = Config(bloom_filter_capacity=50_000,
+                        transport_backend="socket", socket_broker=addr,
+                        snapshot_dir=str(snap), snapshot_mode="delta",
+                        snapshot_every_batches=2)
+        pipe = FusedPipeline(config, client=SocketClient(addr),
+                             num_banks=8)
+        restored_events = sum(pipe.validity_counts())
+        pipe.run(idle_timeout_s=3.0)
+        got = _state(pipe)
+        pipe.cleanup()
+
+        # Uninterrupted oracle over the same deterministic frames.
+        from attendance_tpu.transport.memory_broker import (
+            MemoryBroker, MemoryClient)
+
+        oclient = MemoryClient(MemoryBroker())
+        oracle = FusedPipeline(
+            Config(bloom_filter_capacity=50_000,
+                   transport_backend="memory"),
+            client=oclient, num_banks=8)
+        oracle.preload(roster)
+        oproducer = oclient.create_producer("attendance-events")
+        for f in frames:
+            oproducer.send(f)
+        oracle.run(max_events=NUM_EVENTS, idle_timeout_s=2.0)
+        want = _state(oracle)
+
+        print(f"restored_events_at_boot={restored_events} "
+              f"recovered={got} oracle={want}", flush=True)
+        if got != want:
+            print("FAIL: crash+restore diverged from the "
+                  "uninterrupted oracle (acked events lost or "
+                  "double-counted)")
+            return 1
+        print("recovery lossless; running doctor on the worker's "
+              "artifacts", flush=True)
+        doctor = subprocess.run(
+            [sys.executable, "-m", "attendance_tpu.cli", "doctor",
+             str(prom), str(alerts),
+             "--snapshot-stall-ceiling", str(args.stall_ceiling)],
+            cwd=str(REPO))
+        if doctor.returncode != 0:
+            print(f"FAIL: doctor exited {doctor.returncode}")
+            return doctor.returncode
+        print("PASS: delta-snapshot crash recovery + doctor gate")
+        return 0
+    finally:
+        if worker is not None and worker.poll() is None:
+            worker.kill()
+            worker.wait()
+        broker_proc.kill()
+        broker_proc.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
