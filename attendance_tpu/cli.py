@@ -339,17 +339,46 @@ def cmd_doctor(args) -> None:
     exposition file, an --alert-log JSONL, a flight-recorder dump,
     and/or a --trace-out export, print a pass/fail verdict table, and
     exit non-zero on an SLO breach — the run's own telemetry artifacts
-    become a CI gate without rerunning anything. Exit codes: 0 = all
-    checks pass, 1 = at least one breach, 2 = unreadable artifacts."""
+    become a CI gate without rerunning anything. ``--quarantine DIR``
+    lists the on-disk dead-letter quarantine in the verdict;
+    ``--replay-quarantine`` republishes its frames through the
+    configured transport (the recovery half of the DLQ). Exit codes:
+    0 = all checks pass, 1 = at least one breach, 2 = unreadable
+    artifacts."""
     import sys
 
     from attendance_tpu.obs.slo import doctor_report
 
+    if args.replay_quarantine:
+        if not args.quarantine:
+            logger.error("--replay-quarantine needs --quarantine DIR")
+            sys.exit(2)
+        from attendance_tpu.transport import make_client
+        from attendance_tpu.transport.quarantine import replay
+
+        config = config_from_args(args)
+        client = make_client(config)
+        try:
+            producer = client.create_producer(config.pulsar_topic)
+            n = replay(args.quarantine, producer,
+                       remove=args.purge_replayed)
+        finally:
+            client.close()
+        print(f"replayed {n} quarantined frame(s) onto "
+              f"{config.pulsar_topic}"
+              + (" (entries purged)" if args.purge_replayed else ""))
+        if not args.artifacts:
+            return
+    if not args.artifacts and not args.quarantine:
+        logger.error("doctor needs artifacts and/or --quarantine DIR")
+        sys.exit(2)
     try:
         text, ok = doctor_report(
             args.artifacts, fpr_ceiling=args.fpr_ceiling,
             hll_error_ceiling=args.hll_error_ceiling,
-            snapshot_stall_ceiling=args.snapshot_stall_ceiling)
+            snapshot_stall_ceiling=args.snapshot_stall_ceiling,
+            max_reconnects=args.max_reconnects,
+            quarantine_dir=args.quarantine)
     except FileNotFoundError as e:
         logger.error("no such artifact: %s", e)
         sys.exit(2)
@@ -469,8 +498,10 @@ def main(argv=None) -> None:
     p_doc = sub.add_parser(
         "doctor", help="offline SLO verdict over run artifacts "
         "(prom exposition / alert log / flight dump / trace export); "
+        "lists/replays the dead-letter quarantine; "
         "exits 1 on breach, 2 on unreadable artifacts")
-    p_doc.add_argument("artifacts", nargs="+",
+    add_flags(p_doc)  # transport flags drive --replay-quarantine
+    p_doc.add_argument("artifacts", nargs="*",
                        help="any mix of --metrics-prom, --alert-log, "
                        "flight-recorder, and --trace-out files")
     p_doc.add_argument("--fpr-ceiling", type=float, default=0.01,
@@ -483,6 +514,19 @@ def main(argv=None) -> None:
                        help="gate the snapshot_write/snapshot_blocked "
                        "stage p99 (seconds) recovered from the prom "
                        "histograms; omitted = informational only")
+    p_doc.add_argument("--max-reconnects", type=int, default=None,
+                       help="gate the broker-reconnect total from the "
+                       "prom artifact; omitted = informational row")
+    p_doc.add_argument("--quarantine", default="",
+                       help="list this on-disk dead-letter quarantine "
+                       "in the verdict table")
+    p_doc.add_argument("--replay-quarantine", action="store_true",
+                       help="republish every quarantined frame onto "
+                       "the configured --pulsar-topic via the "
+                       "configured transport")
+    p_doc.add_argument("--purge-replayed", action="store_true",
+                       help="delete quarantine entries after a "
+                       "successful replay publish")
     p_doc.set_defaults(fn=cmd_doctor)
 
     p_par = sub.add_parser(

@@ -193,6 +193,34 @@ class Config:
     # README: SURVEY.md §5 failure detection) which livelocks the
     # subscription on a poison frame; a bounded retry is strictly safer.
     max_redeliveries: int = 3
+    # On-disk quarantine for dead-lettered frames ("" = drop on ack,
+    # the old behavior): handle_poison writes the frame bytes + a
+    # metadata sidecar here before acking, and `doctor --quarantine`
+    # lists / `--replay-quarantine` republishes the entries.
+    quarantine_dir: str = ""
+    # Deterministic fault injection ("" = no fault plane; "off" =
+    # plane installed but every probability zero — the bench's
+    # disabled-cost measurement). Spec grammar (chaos/__init__.py):
+    # comma-separated fault=prob tokens, timed faults fault=dur:prob,
+    # e.g. "drop=0.01,delay=5ms:0.05,dup=0.005,conn_reset=0.002,
+    # persist_fail=0.01,writer_stall=200ms:0.01,corrupt=0.001". All
+    # draws come from per-(site,fault) PRNG streams derived from
+    # chaos_seed, so a failing run replays from its seed.
+    chaos: str = ""
+    chaos_seed: int = 0
+    # Total retry budget for one logical broker RPC over the socket
+    # transport: transient failures reconnect + retry with jittered
+    # exponential backoff inside this window, then surface ONE
+    # BrokerUnavailable.
+    retry_budget_s: float = 15.0
+    # Circuit breaker + durable spill buffer around the persist sink
+    # ("" = raw sink, the default): consecutive insert failures open
+    # the circuit, writes degrade to fsync'd spill files in this
+    # directory, and a half-open probe after the cooldown drains them
+    # back once the sink heals (storage/resilient.py).
+    persist_spill_dir: str = ""
+    persist_breaker_failures: int = 3
+    persist_breaker_cooldown_s: float = 1.0
 
     def validate(self) -> "Config":
         if self.sketch_backend not in ("tpu", "memory", "redis",
@@ -234,6 +262,18 @@ class Config:
             raise ValueError(
                 "slo_fast_s must not exceed slo_slow_s (the slow "
                 "window is what rejects single-window spikes)")
+        if self.chaos:
+            # Parse eagerly: a bad spec must fail at flag time with a
+            # grammar message, not mid-run at the first fault roll.
+            from attendance_tpu.chaos import ChaosSpec
+            ChaosSpec.parse(self.chaos)
+        if self.retry_budget_s <= 0:
+            raise ValueError("retry_budget_s must be positive")
+        if self.persist_breaker_failures <= 0:
+            raise ValueError("persist_breaker_failures must be positive")
+        if self.persist_breaker_cooldown_s <= 0:
+            raise ValueError(
+                "persist_breaker_cooldown_s must be positive")
         if self.invalid_topic and self.invalid_topic == self.pulsar_topic:
             # Republishing invalid events onto the processor's own
             # input topic would re-consume and republish them forever.
@@ -313,6 +353,34 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    help="side topic for computed-invalid events (the "
                    "README-promised attendance-invalid DLQ; empty = off)")
     p.add_argument("--max-redeliveries", type=int, default=d.max_redeliveries)
+    p.add_argument("--quarantine-dir", default=d.quarantine_dir,
+                   help="dead-letter frames into this on-disk "
+                   "quarantine before acking (empty = drop); doctor "
+                   "lists/replays the entries")
+    p.add_argument("--chaos", default=d.chaos,
+                   help="deterministic fault-injection spec, e.g. "
+                   "'drop=0.01,delay=5ms:0.05,conn_reset=0.002,"
+                   "persist_fail=0.01,writer_stall=200ms:0.01,"
+                   "corrupt=0.001' ('off' = plane installed, never "
+                   "fires; empty = no plane)")
+    p.add_argument("--chaos-seed", type=int, default=d.chaos_seed,
+                   help="master seed of the per-(site,fault) fault "
+                   "streams — replay a failing chaos run from its seed")
+    p.add_argument("--retry-budget-s", type=float,
+                   default=d.retry_budget_s,
+                   help="total reconnect+retry window per broker RPC "
+                   "before BrokerUnavailable")
+    p.add_argument("--persist-spill-dir", default=d.persist_spill_dir,
+                   help="enable the persist-sink circuit breaker and "
+                   "spill degraded writes to fsync'd files here")
+    p.add_argument("--persist-breaker-failures", type=int,
+                   default=d.persist_breaker_failures,
+                   help="consecutive persist failures that open the "
+                   "circuit")
+    p.add_argument("--persist-breaker-cooldown-s", type=float,
+                   default=d.persist_breaker_cooldown_s,
+                   help="seconds an open circuit waits before the "
+                   "half-open probe")
     p.add_argument("--profile-dir", default=d.profile_dir,
                    help="write a jax.profiler trace of the run here")
     p.add_argument("--metrics-json", default=d.metrics_json,
@@ -384,6 +452,13 @@ def config_from_args(args: argparse.Namespace) -> Config:
         wire_format=args.wire_format,
         invalid_topic=args.invalid_topic,
         max_redeliveries=args.max_redeliveries,
+        quarantine_dir=args.quarantine_dir,
+        chaos=args.chaos,
+        chaos_seed=args.chaos_seed,
+        retry_budget_s=args.retry_budget_s,
+        persist_spill_dir=args.persist_spill_dir,
+        persist_breaker_failures=args.persist_breaker_failures,
+        persist_breaker_cooldown_s=args.persist_breaker_cooldown_s,
         profile_dir=args.profile_dir,
         metrics_json=args.metrics_json,
         metrics_prom=args.metrics_prom,

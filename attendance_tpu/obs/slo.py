@@ -129,6 +129,12 @@ def parse_slo(spec: str) -> Slo:
     if alias == "throughput":
         return Slo("throughput", "rate", "attendance_events_total",
                    op, threshold)
+    if alias == "snapshot_failures":
+        # The PR-robustness hook: a bounded-backoff writer retrying a
+        # failing disk is healthy; an unbounded failure COUNT is not.
+        return Slo("snapshot_write_failures", "counter",
+                   "attendance_snapshot_write_failures_total", op,
+                   threshold)
     m = _QUANTILE_RE.match(alias)
     if m:
         stage = _STAGE_ALIAS.get(m.group(1), m.group(1))
@@ -458,7 +464,8 @@ def _fmt_value(v: Optional[float]) -> str:
 def _prom_checks(text: str, fpr_ceiling: float,
                  hll_error_ceiling: float,
                  fire_burn: float,
-                 snapshot_stall_ceiling: Optional[float]
+                 snapshot_stall_ceiling: Optional[float],
+                 max_reconnects: Optional[int] = None
                  ) -> List[List[str]]:
     from attendance_tpu.obs.exposition import parse_prom
 
@@ -539,6 +546,37 @@ def _prom_checks(text: str, fpr_ceiling: float,
     if chain:
         rows.append(["snapshot chain length", _fmt_value(max(chain)),
                      "-", "info"])
+    # Self-healing transport: reconnects are REMEDIATION (each one is
+    # a survived outage), so the row is informational by default —
+    # --max-reconnects turns it into a gate for runs that should have
+    # seen a quiet network.
+    recon = _vals("attendance_reconnects_total")
+    if recon or max_reconnects is not None:
+        worst = max(recon) if recon else 0.0
+        if max_reconnects is None:
+            rows.append(["broker reconnects", _fmt_value(worst), "-",
+                         "info"])
+        else:
+            rows.append(["broker reconnects", _fmt_value(worst),
+                         f"<= {max_reconnects}",
+                         "PASS" if worst <= max_reconnects else "FAIL"])
+    retries = _vals("attendance_retry_attempts_total")
+    if retries:
+        rows.append(["broker RPC retries",
+                     _fmt_value(sum(retries)), "-", "info"])
+    snap_fail = _vals("attendance_snapshot_write_failures_total")
+    if snap_fail:
+        rows.append(["snapshot write failures",
+                     _fmt_value(max(snap_fail)), "-", "info"])
+    circ = [(labels, v) for name, labels, v in samples
+            if name == "attendance_circuit_state"]
+    if circ:
+        worst = max(float(v) for _, v in circ)
+        # 0 = closed: a circuit still open/half-open at the last scrape
+        # means the sink never healed — spilled batches are stranded.
+        rows.append(["persist circuit state at last scrape",
+                     _fmt_value(worst), "== 0 (closed)",
+                     "PASS" if worst == 0.0 else "FAIL"])
     firing = [(labels, v) for name, labels, v in samples
               if name == "attendance_slo_firing" and float(v) >= 1.0]
     rows.append(["SLO alerts firing at last scrape", str(len(firing)),
@@ -575,18 +613,41 @@ def _alert_checks(events: List[dict]) -> Tuple[List[List[str]],
     return rows, traces
 
 
+def _quarantine_rows(directory: str) -> List[List[str]]:
+    """Quarantine listing as verdict rows: entry count (informational —
+    dead-lettered poison is a data-quality fact, not an SLO breach) and
+    a per-reason breakdown."""
+    from attendance_tpu.transport.quarantine import list_entries
+
+    entries = list_entries(directory)
+    rows = [["quarantined frames", str(len(entries)), "-", "info"]]
+    by_reason: Dict[str, int] = {}
+    for e in entries:
+        reason = e.get("reason") or "unspecified"
+        by_reason[reason] = by_reason.get(reason, 0) + 1
+    for reason in sorted(by_reason):
+        rows.append([f"  quarantine[{reason}]",
+                     str(by_reason[reason]), "-", "info"])
+    return rows
+
+
 def doctor_report(paths: Sequence[str], *,
                   fpr_ceiling: float = 0.01,
                   hll_error_ceiling: float = 0.02,
                   fire_burn: float = DEFAULT_FIRE_BURN,
-                  snapshot_stall_ceiling: Optional[float] = None
+                  snapshot_stall_ceiling: Optional[float] = None,
+                  max_reconnects: Optional[int] = None,
+                  quarantine_dir: str = ""
                   ) -> Tuple[str, bool]:
     """Replay run artifacts offline; returns (verdict text, ok).
 
     Accepts any mix of: a ``--metrics-prom`` exposition file (the last
     scrape block is judged), a ``--alert-log`` JSONL, a flight-recorder
-    dump, a ``--trace-out`` export. Unknown/unreadable files raise —
-    the CLI maps that to exit 2, distinct from the SLO-breach exit 1.
+    dump, a ``--trace-out`` export — plus, via ``quarantine_dir``, an
+    on-disk quarantine whose entries are listed informationally.
+    ``max_reconnects`` turns the reconnect row from informational into
+    a gate. Unknown/unreadable files raise — the CLI maps that to exit
+    2, distinct from the SLO-breach exit 1.
     """
     from attendance_tpu.obs.exposition import _table
 
@@ -601,7 +662,8 @@ def doctor_report(paths: Sequence[str], *,
         if kind == "prom":
             rows.extend(_prom_checks(payload, fpr_ceiling,
                                      hll_error_ceiling, fire_burn,
-                                     snapshot_stall_ceiling))
+                                     snapshot_stall_ceiling,
+                                     max_reconnects))
         elif kind == "alerts":
             arows, traces = _alert_checks(payload)
             rows.extend(arows)
@@ -624,9 +686,13 @@ def doctor_report(paths: Sequence[str], *,
         found = sum(1 for t in alert_traces if t in trace_ids)
         rows.append(["alert trace ids found in trace/flight artifacts",
                      f"{found}/{len(alert_traces)}", "-", "info"])
+    if quarantine_dir:
+        artifacts.append(f"quarantine: {Path(quarantine_dir).name}")
+        rows.extend(_quarantine_rows(quarantine_dir))
     if not rows:
         raise ValueError("no judgeable artifacts (need a prom "
-                         "exposition file or an alert log)")
+                         "exposition file, an alert log, or a "
+                         "quarantine dir)")
     ok = not any(r[3] == "FAIL" for r in rows)
     failed = sum(1 for r in rows if r[3] == "FAIL")
     head = [f"doctor: {len(artifacts)} artifact(s) — "

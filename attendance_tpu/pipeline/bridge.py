@@ -35,8 +35,8 @@ from attendance_tpu.pipeline.events import (
     encode_planar_batch)
 from attendance_tpu.pipeline.processor import ProcessorMetrics
 from attendance_tpu.transport import (
-    acknowledge_all, collect_batch, collect_chunks, handle_poison,
-    make_client)
+    PoisonTracker, acknowledge_all, collect_batch, collect_chunks,
+    handle_poison, make_client)
 
 logger = logging.getLogger(__name__)
 
@@ -60,6 +60,11 @@ class JsonBinaryBridge:
         self._obs = obs.ensure(self.config)
         self._tracer = (self._obs.tracer if self._obs is not None
                         else None)
+        # Fault plane: the bridge's own named fault point is
+        # ``bridge.forward`` (injected delay before republish); its
+        # transport faults ride the chaos-wrapped client below.
+        from attendance_tpu import chaos
+        self._chaos = chaos.ensure(self.config)
         self.client = client or make_client(self.config)
         self.consumer = self.client.subscribe(
             self.config.pulsar_topic, self.SUBSCRIPTION)
@@ -75,6 +80,9 @@ class JsonBinaryBridge:
         # bridge's dominant cost at JSON-wire rates.
         self._chunk = hasattr(self.consumer, "receive_chunk")
         self._raw = hasattr(self.consumer, "receive_many_raw")
+        # Poison-attempt bound immune to reconnect-requeue inflation
+        # of the broker redelivery count (transport.PoisonTracker).
+        self._poison = PoisonTracker()
 
     def _forward(self, payloads, acks, chunks=None) -> None:
         """Convert one micro-batch and publish it.
@@ -88,6 +96,10 @@ class JsonBinaryBridge:
         per-message entries only on the poison path — which is off the
         steady-state budget by definition.
         """
+        if self._chaos is not None:
+            d = self._chaos.delay_s("bridge.forward")
+            if d:
+                time.sleep(d)
         raw = self._raw or chunks is not None
         span = out_props = None
         if self._tracer is not None and acks:
@@ -119,10 +131,14 @@ class JsonBinaryBridge:
                         [decode_event(payload)]))
                     good.append(tok)
                 except Exception:
-                    msg = (Message(tok[1], tok[0], tok[2]) if raw
-                           else tok)
+                    # Raw tuples are (mid, data, red, props): keep the
+                    # properties so a quarantined frame's sidecar
+                    # still carries its trace context.
+                    msg = (Message(tok[1], tok[0], tok[2], tok[3])
+                           if raw else tok)
                     handle_poison(msg, self.consumer, self.metrics,
-                                  self.config, logger, count_nack=False)
+                                  self.config, logger, count_nack=False,
+                                  tracker=self._poison)
             if not good:
                 if span is not None:  # whole batch dead-lettered
                     self._tracer.end_span(span, error="all-poison")

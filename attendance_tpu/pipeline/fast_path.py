@@ -69,7 +69,7 @@ from attendance_tpu.pipeline.events import decode_binary_batch
 from attendance_tpu.pipeline.processor import ProcessorMetrics
 from attendance_tpu.storage.columnar_store import ColumnarEventStore
 from attendance_tpu.transport import (
-    acknowledge_all, handle_poison, make_client)
+    PoisonTracker, acknowledge_all, handle_poison, make_client)
 from attendance_tpu.transport.memory_broker import ReceiveTimeout
 from attendance_tpu.utils.profiling import (
     annotate_trace, maybe_annotate, maybe_trace)
@@ -149,10 +149,21 @@ class FusedPipeline:
             self._h_snap_write = self._obs.stage("snapshot_write")
             self._h_snap_blocked = self._obs.stage("snapshot_blocked")
         self._last_wire = ""
+        # Fault plane (chaos/): install the injector BEFORE transport
+        # and store construction so both seams pick it up; None (the
+        # default) keeps every hook at one branch.
+        from attendance_tpu import chaos
+        self._chaos = chaos.ensure(self.config)
         self.client = client or make_client(self.config)
         self.consumer = self.client.subscribe(
             self.config.pulsar_topic, self.SUBSCRIPTION)
-        self.store = store or ColumnarEventStore()
+        from attendance_tpu.storage import wrap_store
+        self.store = wrap_store(store or ColumnarEventStore(),
+                                self.config, sink="columnar")
+        # Poison retries bounded by the frame's OWN failure count, not
+        # the broker redelivery count (which reconnect/takeover
+        # requeues inflate for healthy frames).
+        self._poison = PoisonTracker()
         self.sharded = (self.config.num_shards
                         * self.config.num_replicas) > 1
         if self.sharded:
@@ -290,6 +301,12 @@ class FusedPipeline:
         self._snap_jobs: deque = deque()
         self._snap_cv = threading.Condition()
         self._snap_pending = 0
+        # Consecutive background-write failures: drives the bounded
+        # inter-attempt backoff (_writer_backoff_s) so a persistently
+        # failing snapshot disk retries at a bounded cadence instead
+        # of spinning the writer hot, plus the failure counter's SLO
+        # hook (--slo snapshot_failures<=N).
+        self._snap_fail_streak = 0
         self._snap_thread: Optional[threading.Thread] = None
         self._snap_io_lock = threading.Lock()
         self._snap_copy = None
@@ -331,17 +348,21 @@ class FusedPipeline:
         # covers it, so the next barrier must write a fresh full base
         # before deltas (which never carry Bloom words) may chain on.
         self._base_stale = True
+        if self.sharded:
+            self.engine.preload(keys)
+        else:
+            self.state = self.state._replace(bloom_bits=chunked_preload(
+                self._preload, self.state.bloom_bits, keys))
         if self._auditor is not None:
             # The roster IS the filter's full membership (the hot loop
             # never BF.ADDs): its sampled subset is the shadow's
             # ground truth for both the false-negative probe and the
-            # measured-FPR negative classification.
+            # measured-FPR negative classification. Recorded strictly
+            # AFTER the device preload: the FN probe re-queries the
+            # live filter from the scrape thread, and shadowing keys
+            # the filter does not hold yet reads the whole roster as
+            # false negatives (seen under chaos-soak timing).
             self._auditor.record_roster(keys)
-        if self.sharded:
-            self.engine.preload(keys)
-            return
-        self.state = self.state._replace(bloom_bits=chunked_preload(
-            self._preload, self.state.bloom_bits, keys))
 
     # -- bank mapping -------------------------------------------------------
     def _num_banks(self) -> int:
@@ -1332,22 +1353,59 @@ class FusedPipeline:
             pipe = pipe_ref()
             if pipe is None:
                 return  # frames stay unacked; process is tearing down
+            backoff = pipe._writer_backoff_s()
+            if backoff:
+                # Bounded backoff BETWEEN attempts after failures (the
+                # queue slot was already released, so the hot loop
+                # keeps overlapping; only durability lags).
+                time.sleep(backoff)
             pipe._run_snap_job_logged(job)
+
+    def _writer_backoff_s(self) -> float:
+        """Delay before the writer's next attempt: 0 while healthy,
+        exponential from 50ms after consecutive failures, capped at
+        5s — bounded, so recovery latency after the disk heals is
+        bounded too."""
+        streak = self._snap_fail_streak
+        if streak <= 0:
+            return 0.0
+        return min(0.05 * 2 ** min(streak - 1, 7), 5.0)
 
     def _run_snap_job_logged(self, job: dict) -> None:
         t0 = time.perf_counter()
+        inj = self._chaos
         try:
+            if inj is not None:
+                stall = inj.stall_s("snapshot.writer")
+                if stall:
+                    time.sleep(stall)  # injected writer stall
+                if inj.roll("snapshot.writer", "snap_fail"):
+                    from attendance_tpu.chaos import ChaosFault
+                    raise ChaosFault(
+                        "chaos snap_fail at snapshot.writer")
             self._run_snap_job(job)
             acknowledge_all(self.consumer, job["msgs"])
+            self._snap_fail_streak = 0
         except Exception:
             self._base_stale = True
+            self._snap_fail_streak += 1
             if job["kind"] == "base":
                 # The on-disk base is stale/absent: any delta job
                 # already staged behind this one must NOT chain onto
                 # it — the guard in _run_snap_job fails those jobs too
                 # (their frames redeliver) until a fresh base lands.
                 self._writer_base_ok = False
-            logger.exception("Background snapshot failed")
+            obs_t = self._obs
+            if obs_t is not None:
+                obs_t.registry.counter(
+                    "attendance_snapshot_write_failures_total",
+                    help="Background snapshot writes that failed "
+                    "(frames stay unacked; next barrier forces a "
+                    "full base)").inc()
+            logger.exception("Background snapshot failed "
+                             "(consecutive failures: %d, next attempt "
+                             "in %.2fs)", self._snap_fail_streak,
+                             self._writer_backoff_s())
         finally:
             t_done = time.perf_counter()
             stall = t_done - t0
@@ -1838,7 +1896,8 @@ class FusedPipeline:
                     self._tracer.end_span(span, error=True)
                 logger.exception("Bad frame")
                 handle_poison(msg, self.consumer, self.metrics,
-                              self.config, logger)
+                              self.config, logger,
+                              tracker=self._poison)
                 continue
             if span is not None:
                 self._tracer.end_span(span)

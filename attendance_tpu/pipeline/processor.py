@@ -39,7 +39,7 @@ from attendance_tpu.sketch.base import ResponseError
 from attendance_tpu.storage import make_event_store
 from attendance_tpu.storage.memory_store import AttendanceRow
 from attendance_tpu.transport import (
-    acknowledge_all, handle_poison, make_client)
+    PoisonTracker, acknowledge_all, handle_poison, make_client)
 from attendance_tpu.transport.memory_broker import ReceiveTimeout
 
 logger = logging.getLogger(__name__)
@@ -170,12 +170,22 @@ class AttendanceProcessor:
             self._h_assembly = self._obs.stage("batch_assembly")
             self._h_sketch = self._obs.stage("sketch")
             self._h_persist = self._obs.stage("persist")
+        # Fault plane (chaos/): installed before transport/store so
+        # both seams pick the injector up; one branch when absent.
+        from attendance_tpu import chaos
+        chaos.ensure(self.config)
         self.client = client or make_client(self.config)
         self.consumer = self.client.subscribe(
             self.config.pulsar_topic, self.SUBSCRIPTION)
         self.sketch = sketch_store or make_sketch_store(self.config)
-        self.store = event_store or make_event_store(self.config)
+        from attendance_tpu.storage import wrap_store
+        self.store = wrap_store(
+            event_store or make_event_store(self.config), self.config,
+            sink=self.config.storage_backend)
         self.metrics = ProcessorMetrics()
+        # Client-side poison-attempt bound (see transport.PoisonTracker:
+        # reconnect requeues must not push healthy events into the DLQ).
+        self._poison = PoisonTracker()
         self._profiling = bool(self.config.profile_dir)
         # Optional invalid-event side topic (config.invalid_topic): the
         # reference's README promises an "attendance-invalid" routing
@@ -469,7 +479,8 @@ class AttendanceProcessor:
                 except Exception:
                     handle_poison(m, self.consumer, self.metrics,
                                   self.config, logger,
-                                  count_nack=False)
+                                  count_nack=False,
+                                  tracker=self._poison)
             span = None
             if self._tracer is not None and good_msgs:
                 span = self._begin_batch_span(good_msgs[0], t_asm,

@@ -30,3 +30,29 @@ def make_event_store(config):
         from attendance_tpu.storage.cassandra_store import CassandraEventStore
         return CassandraEventStore(config)
     raise ValueError(f"unknown storage backend {config.storage_backend!r}")
+
+
+def wrap_store(store, config, *, sink: str = "events"):
+    """Apply the failure-plane layers to a persist sink, innermost
+    first: ``persist_fail`` chaos injection (chaos/ChaosEventStore)
+    when the installed spec carries it, then the circuit breaker +
+    durable spill buffer (storage/resilient.ResilientEventStore) when
+    ``persist_spill_dir`` is set. With neither configured the store is
+    returned untouched — the hot path keeps its raw sink."""
+    from attendance_tpu import chaos
+
+    inj = chaos.ensure(config)
+    if inj is not None and inj.active("persist_fail"):
+        store = chaos.ChaosEventStore(store, inj)
+    spill = getattr(config, "persist_spill_dir", "")
+    if spill:
+        from attendance_tpu.storage.resilient import (
+            CircuitBreaker, ResilientEventStore)
+        store = ResilientEventStore(
+            store, spill, sink=sink,
+            breaker=CircuitBreaker(
+                failure_threshold=getattr(
+                    config, "persist_breaker_failures", 3),
+                cooldown_s=getattr(
+                    config, "persist_breaker_cooldown_s", 1.0)))
+    return store

@@ -20,8 +20,12 @@ those call shapes API-stable across two backends selected by
   * "pulsar" — the real broker via pulsar-client (import-gated).
 """
 
+from typing import Optional
+
 from attendance_tpu.transport.memory_broker import (  # noqa: F401
     MemoryBroker, MemoryClient, ReceiveTimeout)
+from attendance_tpu.transport.resilience import (  # noqa: F401
+    BrokerUnavailable, RetryPolicy)
 
 
 def redelivery_count(msg) -> int:
@@ -34,8 +38,42 @@ def redelivery_count(msg) -> int:
     return rc() if callable(rc) else rc
 
 
+class PoisonTracker:
+    """Client-side poison-attempt counts per message id.
+
+    The broker's ``redelivery_count`` is bumped by EVERY requeue —
+    nacks, but also crash takeovers and live-reconnect session resumes
+    — so under connection churn a perfectly healthy frame arrives with
+    a high count, and one transient decode failure (e.g. in-flight
+    corruption) would then tip it straight into the dead-letter path:
+    a REAL frame lost to someone else's reconnects (observed under
+    chaos soak). Counting poison attempts here instead bounds retries
+    by how often THIS frame actually failed, no matter how often the
+    transport requeued it in between. Bounded LRU: only failing
+    messages are ever tracked."""
+
+    def __init__(self, cap: int = 4096):
+        from collections import OrderedDict
+
+        self._counts = OrderedDict()
+        self._cap = cap
+
+    def bump(self, message_id) -> int:
+        """Record one poison attempt; returns the total so far."""
+        count = self._counts.pop(message_id, 0) + 1
+        self._counts[message_id] = count
+        if len(self._counts) > self._cap:
+            self._counts.popitem(last=False)
+        return count
+
+    def forget(self, message_id) -> None:
+        self._counts.pop(message_id, None)
+
+
 def handle_poison(msg, consumer, metrics, config, logger, *,
-                  count_nack: bool = True) -> None:
+                  count_nack: bool = True,
+                  reason: str = "poison-frame",
+                  tracker: Optional[PoisonTracker] = None) -> None:
     """Bounded-retry poison-message policy shared by both processors.
 
     Nack for broker redelivery up to ``config.max_redeliveries`` attempts,
@@ -44,9 +82,54 @@ def handle_poison(msg, consumer, metrics, config, logger, *,
     attendance_processor.py:134-136, no DLQ despite its README).
     ``count_nack=False`` skips the nacked_batches counter for callers
     whose unit of nacking is a message, not a batch.
+
+    With ``config.quarantine_dir`` set, the frame's bytes are written
+    to the on-disk quarantine (transport/quarantine) BEFORE the ack —
+    dead-lettering then preserves the only copy instead of dropping it,
+    and ``doctor`` can list/replay the entry. A quarantine write
+    failure falls back to the old drop-on-ack behavior (the
+    subscription must not livelock because the quarantine disk died).
+
+    ``tracker`` (a :class:`PoisonTracker`, one per consumer) bounds
+    retries by the frame's OWN failure count instead of the broker's
+    redelivery count, which reconnect/takeover requeues inflate for
+    healthy frames too. Without one, the old broker-count behavior
+    applies.
     """
-    attempts = redelivery_count(msg)
+    if tracker is not None:
+        # Completed nacks so far for THIS frame's own failures — the
+        # same quantity redelivery_count measures on a quiet network.
+        attempts = tracker.bump(msg.message_id) - 1
+        # Backstop: the tracker's LRU forgets under a mass-poison
+        # burst wider than its cap (every frame would then read as
+        # attempt 0 forever — the nack-forever livelock reborn). The
+        # broker's redelivery count grows monotonically no matter what
+        # this client remembers, so past a generous multiple of the
+        # bound the frame dead-letters regardless; the margin keeps
+        # ordinary reconnect-requeue inflation from tripping it.
+        backstop = max(4 * config.max_redeliveries, 8)
+        attempts = max(attempts,
+                       redelivery_count(msg) - backstop
+                       + config.max_redeliveries)
+    else:
+        attempts = redelivery_count(msg)
     if attempts >= config.max_redeliveries:
+        if tracker is not None:
+            tracker.forget(msg.message_id)
+        qdir = getattr(config, "quarantine_dir", "")
+        if qdir:
+            try:
+                from attendance_tpu.transport.quarantine import (
+                    get_quarantine)
+                props = (msg.properties()
+                         if hasattr(msg, "properties") else None)
+                get_quarantine(qdir).put(
+                    msg.data(), topic=config.pulsar_topic,
+                    reason=reason, redeliveries=attempts,
+                    properties=props)
+            except Exception:
+                logger.exception(
+                    "Quarantine write failed; dead-lettering anyway")
         logger.error("Dead-lettering poison frame after %d redeliveries",
                      attempts)
         metrics.dead_lettered += 1
@@ -138,14 +221,39 @@ def acknowledge_all(consumer, msgs) -> None:
 
 
 def make_client(config):
-    """Build the transport client selected by config.transport_backend."""
+    """Build the transport client selected by config.transport_backend.
+
+    The chaos chokepoint: when ``config.chaos`` is set, the socket
+    backend gets the injector at its RPC seams (drop/conn_reset against
+    real TCP connections) and EVERY backend is wrapped in the
+    backend-agnostic chaos proxies (dup/delay/corrupt) — so the same
+    spec drives the memory broker's hermetic soak and the socket
+    broker's cross-process one."""
+    from attendance_tpu import chaos
+
+    inj = chaos.ensure(config)
     if config.transport_backend == "memory":
-        return MemoryClient(MemoryBroker.shared())
-    if config.transport_backend == "socket":
+        client = MemoryClient(MemoryBroker.shared())
+    elif config.transport_backend == "socket":
+        from attendance_tpu.transport.resilience import RetryPolicy
         from attendance_tpu.transport.socket_broker import SocketClient
-        return SocketClient(config.socket_broker)
-    if config.transport_backend == "pulsar":
+        client = SocketClient(config.socket_broker, chaos=inj,
+                              policy=RetryPolicy.from_config(config))
+    elif config.transport_backend == "pulsar":
         from attendance_tpu.transport.pulsar_client import PulsarClient
-        return PulsarClient(config.pulsar_host)
-    raise ValueError(
-        f"unknown transport backend {config.transport_backend!r}")
+        client = PulsarClient(config.pulsar_host)
+        if inj is not None:
+            # The chaos proxies rebuild corrupted messages as
+            # memory-broker Messages (attribute call-shape) — wrapping
+            # the real pulsar client would hand its consumers
+            # wrong-typed messages on the poison path. The fault plane
+            # targets the framework-native backends.
+            import logging
+            logging.getLogger(__name__).warning(
+                "--chaos is not supported on the pulsar backend; "
+                "fault plane disabled for this client")
+        return client
+    else:
+        raise ValueError(
+            f"unknown transport backend {config.transport_backend!r}")
+    return client if inj is None else chaos.ChaosClient(client, inj)

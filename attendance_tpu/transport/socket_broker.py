@@ -40,6 +40,9 @@ from typing import Dict, Optional, Tuple
 
 from attendance_tpu.transport.memory_broker import (
     MemoryBroker, Message, ReceiveTimeout)
+from attendance_tpu.transport.resilience import (  # noqa: F401 (re-export)
+    BrokerUnavailable, ChaosDrop, RetryPolicy, note_reconnect,
+    resilient_call)
 
 logger = logging.getLogger(__name__)
 
@@ -310,44 +313,141 @@ class _Rpc:
     short round-trips); each consumer gets a DEDICATED channel, because
     a blocking receive holds its channel for up to a full server wait
     round (~10s) and must not stall producers or sibling consumers used
-    from other threads of the same client."""
+    from other threads of the same client.
 
-    def __init__(self, address: str):
-        host, port = address.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)))
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    The channel is RECONNECTABLE: a transport failure marks it broken
+    (and severs the socket — the server's connection-drop takeover then
+    requeues any in-flight deliveries), and :meth:`reconnect` opens a
+    fresh connection and bumps ``generation`` so session-holding
+    callers (consumers) know their server-side handle died with the old
+    connection and must re-subscribe. The retry loop around both lives
+    in transport/resilience.resilient_call.
+
+    With a chaos injector attached, each call rolls the transport
+    faults at this channel's site: ``drop`` loses the request before it
+    is sent (pure retry); ``conn_reset`` severs the REAL socket before
+    or after the send (coin flip — request-lost vs reply-lost, the two
+    wire directions), so the remediation exercised is the same
+    reconnect path a genuine peer reset takes."""
+
+    def __init__(self, address: str, *, chaos=None,
+                 site: str = "socket"):
+        self._address = address
+        self._chaos = chaos
+        self._site = site
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self.generation = 0
+        self.reconnects = 0
+        self._connect_locked()
+
+    def _connect_locked(self) -> None:
+        host, port = self._address.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # Backstop: the server bounds each blocking wait at
         # _MAX_WAIT_MS, so a healthy server always replies well within
         # this; only a dead/hung server trips it.
-        self._sock.settimeout(_MAX_WAIT_MS / 1000 + 30)
-        self._lock = threading.Lock()
+        sock.settimeout(_MAX_WAIT_MS / 1000 + 30)
+        self._sock = sock
+
+    @property
+    def broken(self) -> bool:
+        return self._sock is None
+
+    def _sever_locked(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def reconnect(self) -> None:
+        """Open a fresh connection (idempotent: a sibling thread that
+        already reconnected wins). Bumping ``generation`` is what tells
+        consumers their server-side session is gone."""
+        with self._lock:
+            if self._sock is not None:
+                return
+            self._connect_locked()
+            self.generation += 1
+            self.reconnects += 1
+        note_reconnect(self._site)
 
     def call(self, op: int, body: bytes) -> Tuple[int, bytes]:
+        """ONE attempt; transport failures sever the channel and
+        propagate (resilient_call owns the retry/reconnect loop)."""
         with self._lock:
-            _send_frame(self._sock, op, body)
-            return _recv_frame(self._sock)
+            # Local capture: close() nulls self._sock WITHOUT the lock
+            # (it must wake a parked recv, never queue behind it), so
+            # every use below goes through this snapshot — a racing
+            # close turns into an OSError from the closed fd, which is
+            # the designed sever-and-retry path, not an AttributeError.
+            sock = self._sock
+            if sock is None:
+                raise ConnectionError("broker connection is down")
+            c = self._chaos
+            sever_after = False
+            if c is not None:
+                d = c.delay_s(self._site)
+                if d:
+                    time.sleep(d)
+                if c.roll(self._site, "drop"):
+                    raise ChaosDrop(
+                        f"chaos drop at {self._site} (request lost)")
+                if c.roll(self._site, "conn_reset"):
+                    if c.coin(self._site, "conn_reset"):
+                        self._sever_locked()
+                        raise ConnectionError(
+                            f"chaos conn_reset at {self._site} "
+                            "(request direction)")
+                    sever_after = True  # reply direction: send executes
+            try:
+                _send_frame(sock, op, body)
+                if sever_after:
+                    self._sever_locked()
+                    raise ConnectionError(
+                        f"chaos conn_reset at {self._site} "
+                        "(reply direction)")
+                return _recv_frame(sock)
+            except (ConnectionError, OSError):
+                self._sever_locked()
+                raise
 
     def try_call(self, op: int, body: bytes
                  ) -> Optional[Tuple[int, bytes]]:
         """call(), but None instead of waiting when another thread
-        holds the channel (e.g. parked in a blocking receive)."""
+        holds the channel (e.g. parked in a blocking receive) or the
+        channel is broken (teardown must not reconnect)."""
         if not self._lock.acquire(blocking=False):
             return None
         try:
-            _send_frame(self._sock, op, body)
-            return _recv_frame(self._sock)
+            sock = self._sock  # close() may null it concurrently
+            if sock is None:
+                return None
+            try:
+                _send_frame(sock, op, body)
+                return _recv_frame(sock)
+            except (ConnectionError, OSError):
+                self._sever_locked()
+                raise
         finally:
             self._lock.release()
 
     def close(self) -> None:
         # shutdown() first so a thread parked in recv() on this channel
         # wakes immediately instead of waiting out the server round.
+        sock = self._sock
+        self._sock = None
+        if sock is None:
+            return
         try:
-            self._sock.shutdown(socket.SHUT_RDWR)
+            sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
         try:
-            self._sock.close()
+            sock.close()
         except OSError:
             pass
 
@@ -359,9 +459,11 @@ def _check(status: int, reply: bytes) -> bytes:
 
 
 class SocketProducer:
-    def __init__(self, rpc: _Rpc, topic: str):
+    def __init__(self, rpc: _Rpc, topic: str,
+                 policy: Optional[RetryPolicy] = None):
         self._rpc = rpc
         self._topic = topic
+        self._policy = policy or RetryPolicy()
         t = topic.encode()
         self._prefix = struct.pack("<H", len(t)) + t
         self._closed = False
@@ -393,10 +495,15 @@ class SocketProducer:
         if self._tracer is not None:
             span, properties = self._tracer.begin_publish(
                 self._topic, next(self._seq), properties)
+        body = self._prefix + _enc_props(properties) + bytes(data)
         try:
-            status, reply = self._rpc.call(
-                _OP_PRODUCE,
-                self._prefix + _enc_props(properties) + bytes(data))
+            # A retried publish whose first attempt DID execute (reply
+            # lost) duplicates the message — safe: every downstream
+            # sink is idempotent / read-time-deduped (SURVEY.md §5).
+            status, reply = resilient_call(
+                self._rpc, lambda: (_OP_PRODUCE, body),
+                site="socket.produce", policy=self._policy,
+                aborted=lambda: self._closed)
         finally:
             if span is not None:
                 self._tracer.end_span(span)
@@ -425,9 +532,12 @@ class SocketProducer:
             parts.append(_enc_props(p))
             parts.append(struct.pack("<I", len(d)))
             parts.append(d)
+        body = b"".join(parts)
         try:
-            status, reply = self._rpc.call(_OP_PRODUCE_MANY,
-                                           b"".join(parts))
+            status, reply = resilient_call(
+                self._rpc, lambda: (_OP_PRODUCE_MANY, body),
+                site="socket.produce", policy=self._policy,
+                aborted=lambda: self._closed)
         finally:
             if span is not None:
                 self._tracer.end_span(span)
@@ -455,14 +565,27 @@ class SocketConsumer:
     ``socket_json_converged: false``). Crash semantics are unchanged:
     buffered messages are still in-flight AT THE SERVER, so a dropped
     connection requeues them for the surviving competitors exactly
-    like un-received ones."""
+    like un-received ones.
+
+    Session resume: every RPC rides transport/resilience.resilient_call
+    — a severed connection (peer reset, broker restart, injected
+    ``conn_reset``) reconnects transparently and, because the
+    server-side consumer handle died with the old connection, the
+    consumer RE-SUBSCRIBES for a fresh handle before retrying. The
+    server's connection-drop takeover requeued everything the old
+    session held in flight (prefetch buffer included — it is dropped
+    on resume), so redelivery covers exactly what the reconnect could
+    have lost: live reconnects reuse the crash-takeover machinery. A
+    broker that stays down past the retry budget surfaces ONE
+    ``BrokerUnavailable``."""
 
     PREFETCH = 16
 
     def __init__(self, rpc: _Rpc, handle: int, owns_rpc: bool = False,
                  owner: "Optional[SocketClient]" = None,
                  topic: str = "", subscription: str = "",
-                 prefetch: int = PREFETCH):
+                 prefetch: int = PREFETCH,
+                 policy: Optional[RetryPolicy] = None):
         self._rpc = rpc
         self._handle = handle
         self._owns_rpc = owns_rpc
@@ -470,6 +593,10 @@ class SocketConsumer:
         self._closed = False
         self._prefetch = max(1, prefetch)
         self._buffered: "deque" = deque()
+        self._policy = policy or RetryPolicy()
+        self._session_gen = rpc.generation
+        self._sub_body = _subscribe_body(topic, subscription)
+        self.resubscribes = 0
         from attendance_tpu import obs
         tel = obs.get()
         if tel is not None:
@@ -489,6 +616,33 @@ class SocketConsumer:
             self._obs_msgs = None
             self._obs_bytes = None
             self._obs_nacks = None
+
+    def _ensure_session(self) -> None:
+        """Re-subscribe after a transport reconnect: the server-side
+        consumer handle (and its in-flight state, prefetch buffer
+        included) died with the old connection — its unacked messages
+        were requeued by the connection-drop takeover and will
+        redeliver to the NEW session, so dropping the stale client
+        buffer loses nothing and keeps delivery in order."""
+        if self._rpc.generation == self._session_gen:
+            return
+        status, reply = self._rpc.call(_OP_SUBSCRIBE, self._sub_body)
+        (self._handle,) = struct.unpack("<I", _check(status, reply))
+        self._session_gen = self._rpc.generation
+        self._buffered.clear()
+        self.resubscribes += 1
+        logger.info("socket consumer re-subscribed after reconnect "
+                    "(session %d)", self._session_gen)
+
+    def _call(self, op: int, body_fn) -> Tuple[int, bytes]:
+        """One consumer RPC through the deadline+retry helper;
+        ``body_fn`` rebuilds the body per attempt so it embeds the
+        CURRENT handle after a session resume."""
+        return resilient_call(
+            self._rpc, lambda: (op, body_fn()),
+            site="socket.consume", policy=self._policy,
+            ensure_session=self._ensure_session,
+            aborted=lambda: self._closed)
 
     def _receive_op(self, op: int, max_n: int,
                     timeout_millis: Optional[int]):
@@ -512,8 +666,9 @@ class SocketConsumer:
                     raise ReceiveTimeout(
                         f"no message within {timeout_millis}ms")
                 wait = min(rem_ms, _MAX_WAIT_MS)
-            status, reply = self._rpc.call(
-                op, struct.pack("<IIi", self._handle, max_n, int(wait)))
+            status, reply = self._call(
+                op, lambda: struct.pack("<IIi", self._handle, max_n,
+                                        int(wait)))
             if status == _ST_TIMEOUT:
                 continue  # deadline not reached yet: wait again
             body = _check(status, reply)
@@ -569,17 +724,22 @@ class SocketConsumer:
         return self._receive_op(_OP_RECEIVE_CHUNK, max_n, timeout_millis)
 
     def acknowledge_chunk(self, chunk_id: int) -> None:
-        _check(*self._rpc.call(
-            _OP_ACK_CHUNK, struct.pack("<IQ", self._handle, chunk_id)))
+        # Settling a chunk from a PRE-reconnect session is a server-
+        # side no-op: the takeover already requeued it, and those
+        # messages redeliver (at-least-once, like every retry here).
+        _check(*self._call(
+            _OP_ACK_CHUNK,
+            lambda: struct.pack("<IQ", self._handle, chunk_id)))
 
     def nack_chunk(self, chunk_id: int) -> None:
-        _check(*self._rpc.call(
-            _OP_NACK_CHUNK, struct.pack("<IQ", self._handle, chunk_id)))
+        _check(*self._call(
+            _OP_NACK_CHUNK,
+            lambda: struct.pack("<IQ", self._handle, chunk_id)))
 
     def explode_chunk(self, chunk_id: int) -> None:
-        _check(*self._rpc.call(
-            _OP_EXPLODE_CHUNK, struct.pack("<IQ", self._handle,
-                                           chunk_id)))
+        _check(*self._call(
+            _OP_EXPLODE_CHUNK,
+            lambda: struct.pack("<IQ", self._handle, chunk_id)))
 
     def receive_many(self, max_n: int,
                      timeout_millis: Optional[int] = None) -> list:
@@ -598,9 +758,10 @@ class SocketConsumer:
 
     def acknowledge_ids(self, message_ids) -> None:
         mids = list(message_ids)
-        body = struct.pack(f"<II{len(mids)}Q", self._handle, len(mids),
-                           *mids)
-        _check(*self._rpc.call(_OP_ACK_IDS, body))
+        _check(*self._call(
+            _OP_ACK_IDS,
+            lambda: struct.pack(f"<II{len(mids)}Q", self._handle,
+                                len(mids), *mids)))
 
     def acknowledge(self, msg: Message) -> None:
         self.acknowledge_ids([msg.message_id])
@@ -613,12 +774,13 @@ class SocketConsumer:
         # redelivery count from its own in-flight state on requeue.
         if self._obs_nacks is not None:
             self._obs_nacks.inc()
-        _check(*self._rpc.call(
-            _OP_NACK, struct.pack("<IQ", self._handle, msg.message_id)))
+        _check(*self._call(
+            _OP_NACK,
+            lambda: struct.pack("<IQ", self._handle, msg.message_id)))
 
     def backlog(self) -> int:
-        status, reply = self._rpc.call(
-            _OP_BACKLOG, struct.pack("<I", self._handle))
+        status, reply = self._call(
+            _OP_BACKLOG, lambda: struct.pack("<I", self._handle))
         (n,) = struct.unpack("<Q", _check(status, reply))
         return n
 
@@ -657,38 +819,53 @@ class SocketConsumer:
             self._abort()
 
 
+def _subscribe_body(topic: str, subscription: str) -> bytes:
+    t, s = topic.encode(), subscription.encode()
+    return (struct.pack("<H", len(t)) + t
+            + struct.pack("<H", len(s)) + s)
+
+
 class SocketClient:
     """pulsar.Client call-shape against a BrokerServer address.
 
     Producers share the client's channel; every consumer gets its own
     TCP connection (see _Rpc), so threaded producer+consumer use works
     like the memory broker's. Consumer connections are closed by
-    consumer.close() and swept by client.close()."""
+    consumer.close() and swept by client.close().
 
-    def __init__(self, address: str):
+    ``chaos`` attaches the fault injector to every channel this client
+    opens; ``policy`` shapes the retry budget all its RPCs share
+    (transport/resilience.RetryPolicy)."""
+
+    def __init__(self, address: str, *, chaos=None,
+                 policy: Optional[RetryPolicy] = None):
         self._address = address
-        self._rpc = _Rpc(address)
+        self._chaos = chaos
+        self._policy = policy or RetryPolicy()
+        self._rpc = _Rpc(address, chaos=chaos, site="socket.produce")
         self._consumers: set = set()
 
     def create_producer(self, topic: str) -> SocketProducer:
-        return SocketProducer(self._rpc, topic)
+        return SocketProducer(self._rpc, topic, policy=self._policy)
 
     def subscribe(self, topic: str, subscription_name: str,
                   consumer_type=None) -> SocketConsumer:
         del consumer_type  # shared semantics, like the memory broker
-        rpc = _Rpc(self._address)
-        t, s = topic.encode(), subscription_name.encode()
-        body = (struct.pack("<H", len(t)) + t
-                + struct.pack("<H", len(s)) + s)
+        rpc = _Rpc(self._address, chaos=self._chaos,
+                   site="socket.consume")
+        body = _subscribe_body(topic, subscription_name)
         try:
-            status, reply = rpc.call(_OP_SUBSCRIBE, body)
+            status, reply = resilient_call(
+                rpc, lambda: (_OP_SUBSCRIBE, body),
+                site="socket.consume", policy=self._policy)
             (handle,) = struct.unpack("<I", _check(status, reply))
         except BaseException:
             rpc.close()
             raise
         consumer = SocketConsumer(rpc, handle, owns_rpc=True, owner=self,
                                   topic=topic,
-                                  subscription=subscription_name)
+                                  subscription=subscription_name,
+                                  policy=self._policy)
         self._consumers.add(consumer)
         return consumer
 

@@ -315,7 +315,11 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
                     snapshot_mode=snapshot_mode,
                     snapshot_every_batches=snapshot_every
                     if snapshot_dir else 0)
-    client = MemoryClient(MemoryBroker())
+    # Mirror production wiring (transport.make_client): when a chaos
+    # injector is installed — the obs bench's disabled-fault-plane
+    # column — the client rides the chaos proxies; no-op otherwise.
+    from attendance_tpu import chaos
+    client = chaos.maybe_wrap(MemoryClient(MemoryBroker()))
     pipe = FusedPipeline(config, client=client, num_banks=num_banks)
 
 
@@ -448,11 +452,24 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
                                 num_banks)
         finally:
             obs.disable()
+    # Disabled fault plane (--chaos off): the injector is INSTALLED —
+    # every transport/writer seam rolls against it — but every
+    # probability is zero, so the measured delta vs the no-plane
+    # control is the pure hook cost. Guardrail: <= 1% throughput.
+    from attendance_tpu import chaos as chaos_mod
+
+    chaos_mod.disable()
+    chaos_mod.ensure(Config(chaos="off"))
+    try:
+        chaos_off = bench_e2e(batch_size, seconds, capacity, num_banks)
+    finally:
+        chaos_mod.disable()
 
     base = max(disabled["events_per_sec"], 1e-9)
     metrics_frac = 1.0 - metrics_only["events_per_sec"] / base
     traced_frac = 1.0 - traced["events_per_sec"] / base
     audited_frac = 1.0 - audited["events_per_sec"] / base
+    chaos_frac = 1.0 - chaos_off["events_per_sec"] / base
     return {
         "disabled_events_per_sec": round(disabled["events_per_sec"], 1),
         "enabled_events_per_sec": round(
@@ -469,12 +486,20 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
         "overhead_frac": round(audited_frac, 4),
         "audit_sample": 0.01,
         "guardrail_pass": audited_frac <= 0.02,
+        # The disabled fault plane's own column (--chaos off: injector
+        # installed, probabilities zero) and its <= 1% guardrail.
+        "chaos_off_events_per_sec": round(
+            chaos_off["events_per_sec"], 1),
+        "chaos_off_overhead_frac": round(chaos_frac, 4),
+        "chaos_guardrail_pass": chaos_frac <= 0.01,
         "disabled_rates": disabled["rates"],
         "enabled_rates": metrics_only["rates"],
         "traced_rates": traced["rates"],
         "audited_rates": audited["rates"],
+        "chaos_off_rates": chaos_off["rates"],
         "converged": (disabled["converged"] and metrics_only["converged"]
-                      and traced["converged"] and audited["converged"]),
+                      and traced["converged"] and audited["converged"]
+                      and chaos_off["converged"]),
         "wire": disabled["wire"],
         "device": disabled["device"],
     }
@@ -1352,11 +1377,14 @@ def main() -> None:
                 **{k: r[k] for k in
                    ("disabled_events_per_sec", "enabled_events_per_sec",
                     "traced_events_per_sec", "audited_events_per_sec",
+                    "chaos_off_events_per_sec",
                     "metrics_overhead_frac", "tracing_overhead_frac",
                     "audit_overhead_frac", "audit_sample",
-                    "guardrail_pass", "disabled_rates", "enabled_rates",
-                    "traced_rates", "audited_rates", "converged",
-                    "wire", "device")},
+                    "guardrail_pass", "chaos_off_overhead_frac",
+                    "chaos_guardrail_pass",
+                    "disabled_rates", "enabled_rates",
+                    "traced_rates", "audited_rates", "chaos_off_rates",
+                    "converged", "wire", "device")},
             }
         elif args.mode == "probe":
             # Helper half of _probe_link_rate (own process: the raw

@@ -39,6 +39,12 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def pytest_configure(config):
+    # Tier-1 runs `-m 'not slow'`; register the marker it filters on.
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1")
+
+
 @pytest.fixture
 def server():
     """A live BrokerServer for socket-transport tests (one lifecycle

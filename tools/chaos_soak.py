@@ -1,0 +1,332 @@
+"""Chaos soak: producer -> socket broker -> fused pipeline under a
+randomized fault schedule, judged against a no-fault oracle (CI gate).
+
+Each seed drives one soak:
+
+1. an **oracle** run — memory broker, no faults — over a deterministic
+   frame backlog establishes ground truth (per-day HLL counts, deduped
+   store rows, valid totals);
+2. the **chaos** run replays the SAME backlog (plus a couple of
+   deliberately poisoned frames) through a real in-process socket
+   broker with the full fault plane armed — request drops, connection
+   resets in both directions, duplicate publishes, in-flight
+   corruption, persist-sink failures, snapshot-writer stalls and
+   failures — all drawn from PRNG streams derived from the seed;
+3. the run must satisfy the four invariants that define correctness
+   here:
+
+   * **bounded termination** — the pipeline drains and exits inside
+     the per-seed deadline (no livelock);
+   * **no acked event lost / fault-run == no-fault oracle** — final
+     HLL counts, deduped rows, and valid totals equal the oracle's
+     exactly (duplicates folded by idempotent sketches + read-time
+     dedup; spilled batches drained by the healed circuit);
+   * **zero Bloom false negatives** — the full-shadow audit counter
+     stays 0;
+   * **self-healing, not operator action** — with ``conn_reset``
+     injected the transport reconnected (reconnects > 0, session
+     resumes > 0); with ``persist_fail`` injected the circuit opened,
+     then half-opened closed, and the spill buffer fully drained;
+     poisoned frames landed in the quarantine (count and sha256 both
+     matching) instead of livelocking the subscription;
+
+4. ``doctor`` replays the run's own telemetry artifacts (prom
+   exposition + alert log + quarantine dir) and must pass.
+
+On failure the driver echoes the seed and the one-line replay command.
+CI runs 3 fixed seeds + 1 ``GITHUB_RUN_ID``-derived seed, each bounded
+at 90 s.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DEFAULT_SPEC = ("drop=0.03,delay=2ms:0.03,dup=0.02,conn_reset=0.03,"
+                "persist_fail=0.15,writer_stall=30ms:0.1,"
+                "snap_fail=0.1,corrupt=0.02")
+NUM_EVENTS, BATCH = 32_768, 512
+ROSTER, LECTURES = 10_000, 8
+POISON_FRAMES = 2
+DATA_SEED_BASE = 7_000  # frame-content seed space, disjoint per soak seed
+
+
+def _frames(seed: int):
+    from attendance_tpu.pipeline.loadgen import generate_frames
+
+    return generate_frames(NUM_EVENTS, BATCH, roster_size=ROSTER,
+                           num_lectures=LECTURES, invalid_fraction=0.1,
+                           seed=DATA_SEED_BASE + seed)
+
+
+def _poison_frames(seed: int):
+    """Deterministically undecodable frames (bad magic): the quarantine
+    path's workload."""
+    import numpy as np
+
+    rng = np.random.default_rng(900_000 + seed)
+    return [b"ATPX" + rng.bytes(64 + 32 * i)
+            for i in range(POISON_FRAMES)]
+
+
+def _state(pipe) -> dict:
+    counts = {int(d): pipe.count(int(d)) for d in pipe.lecture_days()}
+    df = pipe.store.to_dataframe()
+    return {"counts": counts, "rows": len(df),
+            "valid": int(df.is_valid.sum())}
+
+
+def _counter_total(registry, name: str) -> float:
+    total = 0.0
+    for fam_name, _kind, _help, members in registry.collect():
+        if fam_name == name:
+            total += sum(float(m.value) for m in members)
+    return total
+
+
+def _oracle(seed: int) -> dict:
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(
+        Config(bloom_filter_capacity=50_000,
+               transport_backend="memory"),
+        client=client, num_banks=LECTURES)
+    roster, frames = _frames(seed)
+    frames = list(frames)
+    pipe.preload(roster)
+    producer = client.create_producer("attendance-events")
+    for frame in frames:
+        producer.send(frame)
+    pipe.run(max_events=NUM_EVENTS, idle_timeout_s=2.0)
+    state = _state(pipe)
+    pipe.cleanup()
+    return state
+
+
+def run_soak(seed: int, *, spec: str = DEFAULT_SPEC, workdir,
+             max_seconds: float = 90.0) -> dict:
+    """One seeded soak; returns the report dict (report["ok"] is the
+    verdict). Resets the chaos/obs process globals around itself so
+    seeds run back to back in one process."""
+    from attendance_tpu import chaos, obs
+
+    failures = []
+    t_start = time.monotonic()
+
+    def check(cond, label):
+        if not cond:
+            failures.append(label)
+
+    chaos.disable()
+    obs.disable()
+    want = _oracle(seed)
+
+    work = Path(workdir) / f"seed-{seed}"
+    work.mkdir(parents=True, exist_ok=True)
+    prom = work / "metrics.prom"
+    alerts = work / "alerts.jsonl"
+    qdir = work / "quarantine"
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.transport import make_client
+    from attendance_tpu.transport.socket_broker import BrokerServer
+
+    server = BrokerServer().start()
+    config = Config(
+        bloom_filter_capacity=50_000,
+        transport_backend="socket", socket_broker=server.address,
+        chaos=spec, chaos_seed=seed,
+        quarantine_dir=str(qdir),
+        persist_spill_dir=str(work / "spill"),
+        persist_breaker_failures=2, persist_breaker_cooldown_s=0.25,
+        snapshot_dir=str(work / "snaps"), snapshot_mode="delta",
+        snapshot_every_batches=4,
+        max_redeliveries=3, retry_budget_s=10.0,
+        audit_sample=1.0,
+        metrics_prom=str(prom), metrics_interval_s=0.2,
+        alert_log=str(alerts)).validate()
+    # Enable telemetry from the MAIN thread (signal handlers and the
+    # SLO engine belong here), so the worker thread only records.
+    obs.enable(config)
+    inj = chaos.ensure(config)
+
+    pipe = FusedPipeline(config, num_banks=LECTURES)
+    roster, frames = _frames(seed)
+    frames = list(frames)
+    pipe.preload(roster)
+
+    poisons = _poison_frames(seed)
+    pub_client = make_client(config)  # chaos-wrapped: faults on publish
+    producer = pub_client.create_producer(config.pulsar_topic)
+    interval = max(1, len(frames) // (POISON_FRAMES + 1))
+    remaining = list(poisons)
+    for i, frame in enumerate(frames):
+        producer.send(frame)
+        if remaining and (i + 1) % interval == 0:
+            producer.send(remaining.pop(0))  # poison mid-backlog
+    for p in remaining:
+        producer.send(p)
+
+    # Bounded termination: the run gets a hard deadline in a worker
+    # thread; a livelocked pipeline fails the seed instead of hanging
+    # the driver.
+    done = threading.Event()
+    errors = []
+
+    def _run():
+        try:
+            pipe.run(idle_timeout_s=3.0)
+        except BaseException as exc:  # noqa: BLE001 — report, don't hang
+            errors.append(exc)
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=_run, name="soak-pipeline",
+                              daemon=True)
+    worker.start()
+    terminated = done.wait(timeout=max_seconds)
+    check(terminated, "bounded termination (pipeline still running at "
+                      f"{max_seconds:.0f}s — livelock)")
+    check(not errors, f"pipeline raised: {errors!r}")
+
+    report = {"seed": seed, "spec": spec, "oracle": want}
+    if terminated and not errors:
+        pipe.cleanup()  # drains the spill buffer through the breaker
+        got = _state(pipe)
+        report["chaos_state"] = got
+        check(got == want,
+              f"fault-run state diverged from oracle: {got} != {want}")
+
+        # Zero Bloom false negatives (full-shadow audit).
+        registry = obs.get().registry
+        fn = _counter_total(registry,
+                            "attendance_bloom_false_negatives_total")
+        check(fn == 0, f"bloom false negatives: {fn}")
+
+        # Self-healing evidence, injected vs observed.
+        injected = {f"{site}/{fault}": n
+                    for (site, fault), n in sorted(inj.injected.items())}
+        report["injected"] = injected
+        reconnects = _counter_total(registry,
+                                    "attendance_reconnects_total")
+        report["reconnects"] = reconnects
+        if inj.injected_total("conn_reset"):
+            check(reconnects > 0,
+                  "conn_reset injected but no reconnects recorded")
+        store = pipe.store
+        if inj.injected_total("persist_fail"):
+            check(getattr(store, "breaker", None) is not None
+                  and store.breaker.opened_total > 0,
+                  "persist_fail injected but the circuit never opened")
+            check(store.breaker.state == "closed",
+                  f"circuit ended {store.breaker.state!r}, not closed")
+            check(store.spill_pending == 0,
+                  f"{store.spill_pending} spilled batches stranded")
+            report["circuit_opened"] = store.breaker.opened_total
+            report["spilled"] = store.spilled_total
+            report["drained"] = store.drained_total
+
+        # Poison frames: dead-lettered into the quarantine, bytes
+        # intact (sha256 match), none lost, none livelocked.
+        from attendance_tpu.transport.quarantine import list_entries
+        entries = list_entries(qdir)
+        report["quarantined"] = len(entries)
+        report["dead_lettered"] = pipe.metrics.dead_lettered
+        # At-least-once dead-lettering: a dead-letter ACK lost to an
+        # injected reset redelivers the poison frame into one more
+        # bounded cycle, so >= (duplicates share a digest).
+        check(pipe.metrics.dead_lettered >= POISON_FRAMES,
+              f"dead_lettered={pipe.metrics.dead_lettered}, "
+              f"expected >= {POISON_FRAMES}")
+        # The quarantine holds poison frames as RECEIVED — a delivery
+        # that also caught the in-flight ``corrupt`` fault lands as
+        # its (deterministic, involutive) corrupted variant. Every
+        # entry must be a poison frame or its variant (a real frame
+        # in here means the retry bound ate live data), and every
+        # poison frame must appear at least once (none escaped).
+        from attendance_tpu.chaos import ChaosInjector
+        per_poison = [
+            {hashlib.sha256(p).hexdigest(),
+             hashlib.sha256(
+                 ChaosInjector.corrupt_transform(p)).hexdigest()}
+            for p in _poison_frames(seed)]
+        acceptable = set().union(*per_poison)
+        got_digests = [e["sha256"] for e in entries]
+        check(all(d in acceptable for d in got_digests),
+              "non-poison frame quarantined (retry bound ate a real "
+              f"frame): {got_digests}")
+        check(all(any(d in digs for d in got_digests)
+                  for digs in per_poison),
+              "a poison frame never reached the quarantine")
+
+        # Doctor gate over the run's own artifacts.
+        t = obs.get()
+        t.finalize_slo("soak-end")
+        if t._reporter is not None:
+            t._reporter._write_block()
+        from attendance_tpu.obs.slo import doctor_report
+        try:
+            text, ok = doctor_report([str(prom), str(alerts)],
+                                     quarantine_dir=str(qdir))
+            report["doctor_ok"] = ok
+            check(ok, "doctor verdict FAIL:\n" + text)
+        except Exception as exc:  # noqa: BLE001
+            check(False, f"doctor raised: {exc!r}")
+
+    server.stop()
+    obs.disable()
+    chaos.disable()
+    report["wall_s"] = round(time.monotonic() - t_start, 1)
+    report["failures"] = failures
+    report["ok"] = not failures
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, action="append", default=None,
+                    help="soak seed (repeatable; default 1)")
+    ap.add_argument("--spec", default=DEFAULT_SPEC,
+                    help="chaos spec for the fault run")
+    ap.add_argument("--workdir", default="/tmp/chaos_soak")
+    ap.add_argument("--max-seconds", type=float, default=90.0,
+                    help="per-seed deadline (termination invariant)")
+    args = ap.parse_args()
+    seeds = args.seed or [1]
+    rc = 0
+    for seed in seeds:
+        print(f"=== chaos soak seed={seed} spec={args.spec!r}",
+              flush=True)
+        report = run_soak(seed, spec=args.spec, workdir=args.workdir,
+                          max_seconds=args.max_seconds)
+        summary = {k: v for k, v in report.items()
+                   if k not in ("failures", "oracle", "chaos_state")}
+        print(f"seed {seed}: {summary}", flush=True)
+        if report["ok"]:
+            print(f"PASS seed={seed} ({report['wall_s']}s)",
+                  flush=True)
+        else:
+            rc = 1
+            for f in report["failures"]:
+                print(f"FAIL seed={seed}: {f}", flush=True)
+            print(f"SOAK FAIL seed={seed} — replay with:\n  "
+                  f"JAX_PLATFORMS=cpu python tools/chaos_soak.py "
+                  f"--seed {seed} --spec '{args.spec}'", flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
