@@ -393,6 +393,21 @@ class ChaosClient:
             self._inner.subscribe(topic, subscription_name,
                                   consumer_type), self._inj)
 
+    def subscribe_lane(self, topic: str, subscription_name: str,
+                       lane: int):
+        """Lane-affine subscribe, chaos-wrapped: the striped ingress
+        plane's lanes get dup/delay/corrupt proxies exactly like any
+        other consumer (a bare __getattr__ delegation would hand back
+        an unwrapped lane and silently exempt it from the fault
+        plane). Backends without the lane API (memory broker) fall
+        back to a plain chaos-wrapped subscribe — lane affinity there
+        is trivially true (no connection to be affine to)."""
+        inner_sub = getattr(self._inner, "subscribe_lane", None)
+        if inner_sub is None:
+            return self.subscribe(topic, subscription_name)
+        return ChaosConsumer(inner_sub(topic, subscription_name, lane),
+                             self._inj)
+
     def __getattr__(self, name):
         return getattr(self._inner, name)
 

@@ -378,6 +378,7 @@ def cmd_doctor(args) -> None:
             hll_error_ceiling=args.hll_error_ceiling,
             snapshot_stall_ceiling=args.snapshot_stall_ceiling,
             max_reconnects=args.max_reconnects,
+            lane_skew_ceiling=args.lane_skew_ceiling,
             quarantine_dir=args.quarantine)
     except FileNotFoundError as e:
         logger.error("no such artifact: %s", e)
@@ -517,6 +518,12 @@ def main(argv=None) -> None:
     p_doc.add_argument("--max-reconnects", type=int, default=None,
                        help="gate the broker-reconnect total from the "
                        "prom artifact; omitted = informational row")
+    p_doc.add_argument("--lane-skew-ceiling", type=float, default=None,
+                       help="gate the striped-ingress lane skew "
+                       "(worst lane events / median lane events) "
+                       "recovered from the prom artifact — 0.5 flags "
+                       "a lane running under half the median (dead-"
+                       "lane detection); omitted = informational row")
     p_doc.add_argument("--quarantine", default="",
                        help="list this on-disk dead-letter quarantine "
                        "in the verdict table")

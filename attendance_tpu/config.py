@@ -72,6 +72,25 @@ class Config:
     # Micro-batch size for the processor hot loop. Events are padded to this
     # size so every device dispatch has a static shape (XLA: one compile).
     batch_size: int = 8192
+    # Striped ingress plane (pipeline.lanes): number of independent
+    # ingress lanes feeding the fused pipeline. 0 (default) = the
+    # classic single consumer in the run loop; N >= 1 runs N broker
+    # sessions (one TCP connection each on the socket backend), each
+    # with a bridge worker decoding its micro-batches off the dispatch
+    # thread, coalesced into full device batches by one dispatcher —
+    # so N=1 is the striped plane at minimum width (the parity
+    # measurement), not the classic path. Reconnect/resume and poison
+    # handling apply per lane; snapshot group-commit acks release
+    # across lanes.
+    ingress_lanes: int = 0
+    # Decoded blocks each lane may park in its bounded SPSC queue
+    # before the worker blocks (backpressure toward the broker).
+    lane_queue_depth: int = 4
+    # Lane decode engine: "auto" picks the native schema scanner when
+    # the C runtime is loadable (fastest, but holds the GIL) and the
+    # numpy-vectorized batch scanner otherwise; "native"/"vector"
+    # force one (codec.scan_json_batch_columns is the vector engine).
+    lane_decode: str = "auto"
     # Max time to wait filling a batch before flushing a partial one.
     batch_timeout_s: float = 0.05
     # Bloom layout: "flat" (standard double-hashed, Redis-parity FPR math)
@@ -237,6 +256,15 @@ class Config:
             raise ValueError(f"unknown replica sync: {self.replica_sync}")
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
+        if self.ingress_lanes < 0:
+            raise ValueError(
+                "ingress_lanes must be >= 0 (0 = classic single "
+                "consumer, N = striped plane with N lanes)")
+        if self.lane_queue_depth < 1:
+            raise ValueError("lane_queue_depth must be >= 1")
+        if self.lane_decode not in ("auto", "native", "vector"):
+            raise ValueError(
+                f"unknown lane decode engine: {self.lane_decode}")
         if self.snapshot_mode not in ("barrier", "delta"):
             raise ValueError(
                 f"unknown snapshot mode: {self.snapshot_mode}")
@@ -325,6 +353,20 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
     p.add_argument("--hll-precision", type=int, default=d.hll_precision)
     p.add_argument("--batch-size", type=int, default=d.batch_size)
     p.add_argument("--batch-timeout-s", type=float, default=d.batch_timeout_s)
+    p.add_argument("--ingress-lanes", type=int, default=d.ingress_lanes,
+                   help="striped ingress lanes feeding the fused "
+                   "pipeline (0 = classic single consumer; N >= 1 "
+                   "runs N broker sessions with parallel decode "
+                   "workers — 1 is the striped plane at minimum width)")
+    p.add_argument("--lane-queue-depth", type=int,
+                   default=d.lane_queue_depth,
+                   help="decoded blocks buffered per ingress lane "
+                   "before the worker backpressures the broker")
+    p.add_argument("--lane-decode", choices=["auto", "native", "vector"],
+                   default=d.lane_decode,
+                   help="lane JSON decode engine (auto = native "
+                   "scanner when loadable, else the numpy-vectorized "
+                   "batch scanner)")
     p.add_argument("--num-shards", type=int, default=d.num_shards)
     p.add_argument("--num-replicas", type=int, default=d.num_replicas)
     p.add_argument("--replica-sync", choices=["step", "query"],
@@ -440,6 +482,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
         socket_broker=args.socket_broker,
         batch_size=args.batch_size,
         batch_timeout_s=args.batch_timeout_s,
+        ingress_lanes=args.ingress_lanes,
+        lane_queue_depth=args.lane_queue_depth,
+        lane_decode=args.lane_decode,
         bloom_layout=args.bloom_layout,
         hll_precision=args.hll_precision,
         num_shards=args.num_shards,

@@ -465,7 +465,8 @@ def _prom_checks(text: str, fpr_ceiling: float,
                  hll_error_ceiling: float,
                  fire_burn: float,
                  snapshot_stall_ceiling: Optional[float],
-                 max_reconnects: Optional[int] = None
+                 max_reconnects: Optional[int] = None,
+                 lane_skew_ceiling: Optional[float] = None
                  ) -> List[List[str]]:
     from attendance_tpu.obs.exposition import parse_prom
 
@@ -564,6 +565,31 @@ def _prom_checks(text: str, fpr_ceiling: float,
     if retries:
         rows.append(["broker RPC retries",
                      _fmt_value(sum(retries)), "-", "info"])
+    # Striped ingress lane skew: the worst lane's event share vs the
+    # median lane. A dead or starved lane (connection wedged below the
+    # reconnect threshold, poisoned session) shows up as skew long
+    # before it shows up as throughput — informational by default,
+    # --lane-skew-ceiling gates it (the dead-lane detector; 0.5 flags
+    # a lane running under half the median).
+    lane_events = _vals("attendance_ingress_lane_events_total")
+    if len(lane_events) >= 2 or (lane_events
+                                 and lane_skew_ceiling is not None):
+        ordered = sorted(lane_events)
+        mid = len(ordered) // 2
+        # True median (even counts average the middle pair): the
+        # upper-middle element would make the 2-lane gate min/MAX.
+        median = (ordered[mid] if len(ordered) % 2
+                  else (ordered[mid - 1] + ordered[mid]) / 2.0)
+        skew = (ordered[0] / median) if median > 0 else 0.0
+        if lane_skew_ceiling is None:
+            rows.append(["ingress lane skew (min/median)",
+                         _fmt_value(round(skew, 4)), "-", "info"])
+        else:
+            rows.append(["ingress lane skew (min/median)",
+                         _fmt_value(round(skew, 4)),
+                         f">= {_fmt_value(lane_skew_ceiling)}",
+                         "PASS" if skew >= lane_skew_ceiling
+                         else "FAIL"])
     snap_fail = _vals("attendance_snapshot_write_failures_total")
     if snap_fail:
         rows.append(["snapshot write failures",
@@ -637,6 +663,7 @@ def doctor_report(paths: Sequence[str], *,
                   fire_burn: float = DEFAULT_FIRE_BURN,
                   snapshot_stall_ceiling: Optional[float] = None,
                   max_reconnects: Optional[int] = None,
+                  lane_skew_ceiling: Optional[float] = None,
                   quarantine_dir: str = ""
                   ) -> Tuple[str, bool]:
     """Replay run artifacts offline; returns (verdict text, ok).
@@ -663,7 +690,8 @@ def doctor_report(paths: Sequence[str], *,
             rows.extend(_prom_checks(payload, fpr_ceiling,
                                      hll_error_ceiling, fire_burn,
                                      snapshot_stall_ceiling,
-                                     max_reconnects))
+                                     max_reconnects,
+                                     lane_skew_ceiling))
         elif kind == "alerts":
             arows, traces = _alert_checks(payload)
             rows.extend(arows)

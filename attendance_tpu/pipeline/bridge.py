@@ -30,9 +30,9 @@ import numpy as np
 
 from attendance_tpu import obs
 from attendance_tpu.config import Config
+from attendance_tpu.pipeline.codec import get_codec
 from attendance_tpu.pipeline.events import (
-    columns_from_events, decode_event, decode_json_batch_columns,
-    encode_planar_batch)
+    columns_from_events, decode_event)
 from attendance_tpu.pipeline.processor import ProcessorMetrics
 from attendance_tpu.transport import (
     PoisonTracker, acknowledge_all, collect_batch, collect_chunks,
@@ -71,6 +71,10 @@ class JsonBinaryBridge:
         self.out_topic = (out_topic
                           or self.config.pulsar_topic + BINARY_TOPIC_SUFFIX)
         self.producer = self.client.create_producer(self.out_topic)
+        # The bridge IS a codec stage: decode (json wire) -> assemble
+        # (canonical planar block) -> publish. pipeline.codec owns both
+        # halves so the striped lanes and future wires share them.
+        self._codec = get_codec("json")
         self.metrics = ProcessorMetrics()
         # Detected once: the consumer is fixed at construction, and a
         # single flag keeps the drain and ack sites agreeing on the
@@ -106,7 +110,7 @@ class JsonBinaryBridge:
             span, out_props = self._begin_forward_span(acks[0], raw,
                                                        len(payloads))
         try:
-            cols = decode_json_batch_columns(payloads)
+            cols = self._codec.decode(payloads)
             good = acks
         except Exception:
             # A poison payload somewhere in the batch: convert per
@@ -145,7 +149,7 @@ class JsonBinaryBridge:
                 return
             cols = {k: np.concatenate([p[k] for p in parts])
                     for k in parts[0]}
-        self.producer.send(encode_planar_batch(cols),
+        self.producer.send(self._codec.assemble(cols),
                            properties=out_props)
         # Ack strictly after the binary frame is published: the bridge
         # never holds the only copy of an acknowledged event.

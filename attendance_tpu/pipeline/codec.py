@@ -1,0 +1,362 @@
+"""Pluggable ingress codec stage: the decode -> assemble -> dispatch seam.
+
+Five PRs of growth left wire handling interleaved with dispatch and
+snapshot logic in ``fast_path.py`` and ``bridge.py`` (ROADMAP open item
+5).  This module extracts the *ingress* half into one seam with three
+stages and one canonical intermediate:
+
+  * **decode**  — wire payloads -> column arrays (the shape the device
+    kernels eat, ``events.columns_from_events`` layout).  One
+    :class:`IngressCodec` per wire: ``json`` (the reference's per-event
+    wire), ``binary`` (ATB1 record / ATB2 planar bulk frames).
+  * **assemble** — column arrays -> ONE canonical planar binary block
+    (``events.encode_planar_batch``), the fixed format every
+    decode-side component hands to the dispatcher.
+  * **dispatch** — the consumer of assembled blocks
+    (``FusedPipeline.process_frame``), which this module deliberately
+    does NOT own: the seam's contract is that dispatchers only ever see
+    canonical frames, so new wires (scenario wires, compressed /
+    columnar wires, the chaos proxies' corrupted variants) are new
+    codecs, not new branches in the hot loop.
+
+The striped ingress plane (``pipeline.lanes``) is the first client
+built ON the seam instead of into the hot loop: each lane worker runs
+decode+assemble for its own broker session and the dispatcher coalesces
+canonical blocks.
+
+Decode has two engines with identical results (tested differentially):
+the native schema scanner (``events.decode_json_batch_columns`` — the
+fastest single-thread path, but the CPython-API list scan HOLDS the
+GIL), and :func:`scan_json_batch_columns` — a numpy-vectorized batch
+scanner that parses a whole chunk of fast-shape payloads in one pass of
+array ops (the grown-up form of the bench's "c-list" scanner).  The
+vectorized scanner is what makes *threaded* lane decode scale: its
+passes are numpy ufuncs/gathers over the joined byte buffer, which
+release the GIL, where the per-payload and native scans serialize.
+Payloads outside the fast shape fall back to the exact Python codec
+row by row, so results are identical on any input mix.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from attendance_tpu.pipeline.events import (
+    BINARY_DTYPE, BINARY_MAGIC, PLANAR_MAGIC, _HASH_DAY_BASE,
+    _HASH_DAY_LIMIT, columns_from_events, decode_binary_batch,
+    decode_event, decode_json_batch_columns, encode_planar_batch)
+
+COLUMN_KEYS = ("student_id", "lecture_day", "micros", "is_valid",
+               "event_type")
+
+
+# ---------------------------------------------------------------------------
+# Codec interface + registry
+# ---------------------------------------------------------------------------
+
+class IngressCodec:
+    """One wire format's decode/assemble pair.
+
+    ``decode`` maps a micro-batch of wire payloads to column arrays;
+    ``assemble`` maps column arrays to ONE canonical planar block.  A
+    codec must be pure per batch (no cross-batch state) so lane
+    workers can run it concurrently."""
+
+    name = "abstract"
+
+    def decode(self, payloads: Sequence[bytes], *,
+               prefer_gil_release: bool = False
+               ) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def assemble(self, cols: Dict[str, np.ndarray]) -> bytes:
+        """Columns -> canonical planar block (shared by every codec:
+        the dispatcher consumes exactly one format)."""
+        return encode_planar_batch(cols)
+
+
+class JsonCodec(IngressCodec):
+    """The reference's per-event JSON wire
+    (reference data_generator.py:112-123): one JSON object per payload.
+
+    ``prefer_gil_release=True`` selects the numpy-vectorized batch
+    scanner (threaded lane workers); the default path keeps the native
+    list scan, the fastest single-thread engine."""
+
+    name = "json"
+
+    def decode(self, payloads: Sequence[bytes], *,
+               prefer_gil_release: bool = False
+               ) -> Dict[str, np.ndarray]:
+        if prefer_gil_release:
+            return scan_json_batch_columns(payloads)
+        return decode_json_batch_columns(payloads)
+
+
+class BinaryCodec(IngressCodec):
+    """Bulk binary frames: interleaved ATB1 records or planar ATB2
+    blocks, one frame per payload, concatenated into one column set."""
+
+    name = "binary"
+
+    def decode(self, payloads: Sequence[bytes], *,
+               prefer_gil_release: bool = False
+               ) -> Dict[str, np.ndarray]:
+        del prefer_gil_release  # np.frombuffer never holds the GIL long
+        if len(payloads) == 1:
+            return decode_binary_batch(payloads[0])
+        return merge_columns([decode_binary_batch(p) for p in payloads])
+
+
+CODECS: Dict[str, IngressCodec] = {
+    c.name: c for c in (JsonCodec(), BinaryCodec())}
+
+
+def get_codec(name: str) -> IngressCodec:
+    codec = CODECS.get(name)
+    if codec is None:
+        raise KeyError(f"unknown ingress codec {name!r} "
+                       f"(have: {sorted(CODECS)})")
+    return codec
+
+
+def codec_for_frame(data: bytes) -> IngressCodec:
+    """Sniff one payload's wire: binary frames carry the ATB1/ATB2
+    magic; everything else is the JSON wire (a JSON object payload
+    starts with ``{``, and malformed non-JSON payloads must take the
+    JSON codec's poison path, not crash the sniff)."""
+    if data.startswith(BINARY_MAGIC) or data.startswith(PLANAR_MAGIC):
+        return CODECS["binary"]
+    return CODECS["json"]
+
+
+def decode_frame(data: bytes,
+                 include_truth: bool = True) -> Dict[str, np.ndarray]:
+    """One payload -> columns through the sniffed codec.  Binary frames
+    keep the exact zero-copy path ``fast_path`` always used
+    (``decode_binary_batch`` views, ``include_truth`` elided on the hot
+    path); JSON payloads decode as a single-event batch."""
+    if data.startswith(PLANAR_MAGIC) or data.startswith(BINARY_MAGIC):
+        return decode_binary_batch(data, include_truth=include_truth)
+    cols = decode_json_batch_columns([data])
+    if not include_truth:
+        cols = {k: v for k, v in cols.items() if k != "is_valid"}
+    return cols
+
+
+def frame_event_count(data: bytes) -> int:
+    """Event count of one binary frame WITHOUT decoding it (the lane
+    dispatcher's coalescing decisions must not force a decode of raw
+    pass-through blocks)."""
+    if data.startswith(PLANAR_MAGIC):
+        (n,) = np.frombuffer(data, np.uint32, count=1,
+                             offset=len(PLANAR_MAGIC))
+        return int(n)
+    if data.startswith(BINARY_MAGIC):
+        return (len(data) - len(BINARY_MAGIC)) // BINARY_DTYPE.itemsize
+    raise ValueError("not a binary event frame")
+
+
+def merge_columns(blocks: Sequence[Dict[str, np.ndarray]]
+                  ) -> Dict[str, np.ndarray]:
+    """Concatenate column sets (one np C-level memcpy per column; the
+    dispatcher's cross-lane coalesce).  Keys follow the FIRST block:
+    hot-path blocks omit ``is_valid`` uniformly."""
+    if len(blocks) == 1:
+        return blocks[0]
+    keys = blocks[0].keys()
+    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+# ---------------------------------------------------------------------------
+# Vectorized JSON batch scanner
+# ---------------------------------------------------------------------------
+# The reference producer emits json.dumps(dict) with default separators
+# and a fixed key order (reference data_generator.py:112-118):
+#   {"student_id": N, "timestamp": "...", "lecture_id": "LECTURE_...",
+#    "is_valid": true|false, "event_type": "entry"|"exit"}
+# The scanner verifies that exact shape vectorized over the whole
+# chunk; any payload deviating (escapes, timezone suffixes, odd
+# fraction widths, non-LECTURE ids needing murmur3, reordered keys)
+# drops to the per-row Python codec, so results are always identical
+# to decode_event.
+
+_L_SID = b'{"student_id": '
+_L_TS = b', "timestamp": "'
+_L_LID = b'", "lecture_id": "LECTURE_'
+_L_VALID = b'", "is_valid": '
+_L_TRUE = b"true"
+_L_FALSE = b"false"
+_L_ETYPE = b', "event_type": "'
+_L_ENTRY = b'entry'
+_L_EXIT = b'exit'
+_L_END = b'"}'
+
+_US_PER_DAY = 86_400_000_000
+
+
+def scan_json_batch_columns(payloads: Sequence[bytes]
+                            ) -> Dict[str, np.ndarray]:
+    """Whole-chunk vectorized JSON decode (see module docstring).
+
+    One join + ~a hundred numpy passes over the concatenated bytes —
+    no per-event Python for fast-shape payloads, and the heavy passes
+    release the GIL.  Raises on malformed JSON exactly like
+    ``decode_event`` (via the row fallback), so callers keep their
+    per-message poison handling."""
+    n = len(payloads)
+    student = np.zeros(n, np.uint32)
+    day = np.zeros(n, np.uint32)
+    micros = np.zeros(n, np.int64)
+    valid = np.zeros(n, bool)
+    etype = np.zeros(n, np.int8)
+    cols = {"student_id": student, "lecture_day": day, "micros": micros,
+            "is_valid": valid, "event_type": etype}
+    if n == 0:
+        return cols
+    lens = np.fromiter((len(p) for p in payloads), np.int64, n)
+    buf = b"".join(bytes(p) if not isinstance(p, bytes) else p
+                   for p in payloads)
+    arr = np.frombuffer(buf, np.uint8)
+    starts = np.zeros(n, np.int64)
+    np.cumsum(lens[:-1], out=starts[1:])
+    ends = starts + lens
+    ok = np.ones(n, bool)
+    safe_hi = max(arr.size - 1, 0)
+
+    # Positional reads never bounds-check against each payload's own
+    # end: a short payload either mismatches the next literal, or runs
+    # its cursor past its end and fails the final ``pos == ends``
+    # fence — both route it to the exact row fallback.  Only the
+    # buffer-global clamp is needed for safe gathers.
+
+    def gather2(pos, width: int):
+        """(n, width) byte window starting at each payload's cursor —
+        ONE fancy-index per field instead of one per character."""
+        idx = pos[:, None] + np.arange(width, dtype=np.int64)
+        np.minimum(idx, safe_hi, out=idx)
+        return arr[idx]
+
+    def check_lit(pos, lit: bytes):
+        w = gather2(pos, len(lit))
+        np.logical_and(
+            ok, (w == np.frombuffer(lit, np.uint8)).all(axis=1), out=ok)
+        return pos + len(lit)
+
+    def digits_window(w):
+        """Byte window -> per-column digit values + all-digits mask."""
+        d = w.astype(np.int64) - 48
+        return d, ((d >= 0) & (d <= 9)).all(axis=1)
+
+    def var_digits(pos, max_digits: int):
+        """Variable-width unsigned int ending at the first non-digit;
+        ok requires 1..max_digits digits."""
+        w = gather2(pos, max_digits + 1)
+        d = w.astype(np.int64) - 48
+        is_d = ((d >= 0) & (d <= 9)
+                & (pos[:, None] + np.arange(max_digits + 1)
+                   < ends[:, None]))
+        width = np.argmin(is_d, axis=1)  # first non-digit column
+        np.logical_and(ok, (width >= 1) & (width <= max_digits), out=ok)
+        val = np.zeros(n, np.int64)
+        for k in range(max_digits):
+            val = np.where(k < width, val * 10 + d[:, k], val)
+        return val, width, pos + width
+
+    pos = check_lit(starts, _L_SID)
+    sid, _, pos = var_digits(pos, 10)
+    pos = check_lit(pos, _L_TS)
+    # The whole "YYYY-MM-DDTHH:MM:SS" timestamp in ONE gather.
+    ts = gather2(pos, 19)
+    np.logical_and(ok, (ts[:, 4] == ord("-")) & (ts[:, 7] == ord("-"))
+                   & (ts[:, 10] == ord("T")) & (ts[:, 13] == ord(":"))
+                   & (ts[:, 16] == ord(":")), out=ok)
+    td, tmask = digits_window(
+        ts[:, (0, 1, 2, 3, 5, 6, 8, 9, 11, 12, 14, 15, 17, 18)])
+    np.logical_and(ok, tmask, out=ok)
+    year = td[:, 0] * 1000 + td[:, 1] * 100 + td[:, 2] * 10 + td[:, 3]
+    month = td[:, 4] * 10 + td[:, 5]
+    mday = td[:, 6] * 10 + td[:, 7]
+    hh = td[:, 8] * 10 + td[:, 9]
+    mm = td[:, 10] * 10 + td[:, 11]
+    ss = td[:, 12] * 10 + td[:, 13]
+    pos = pos + 19
+    np.logical_and(ok, (month >= 1) & (month <= 12)
+                   & (mday >= 1) & (mday <= 31)
+                   & (hh <= 23) & (mm <= 59) & (ss <= 59), out=ok)
+    # Optional exactly-6-digit fraction (datetime.isoformat emits six
+    # or none); other widths / timezone suffixes take the row fallback.
+    fw = gather2(pos, 7)
+    has_frac = fw[:, 0] == ord(".")
+    fd, fmask = digits_window(fw[:, 1:])
+    np.logical_and(ok, ~has_frac | fmask, out=ok)
+    frac = np.where(
+        has_frac,
+        fd @ np.array([100_000, 10_000, 1_000, 100, 10, 1], np.int64),
+        0)
+    pos = np.where(has_frac, pos + 7, pos)
+    # days-from-civil (proleptic Gregorian; matches
+    # datetime.fromisoformat + UTC pin in events._iso_to_micros).
+    y = year - (month <= 2)
+    era = y // 400
+    yoe = y - era * 400
+    mp = np.where(month > 2, month - 3, month + 9)
+    doy = (153 * mp + 2) // 5 + mday - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    days = era * 146097 + doe - 719468
+    ts_us = (days * _US_PER_DAY + hh * 3_600_000_000
+             + mm * 60_000_000 + ss * 1_000_000 + frac)
+
+    pos = check_lit(pos, _L_LID)
+    dayval, dwidth, pos = var_digits(pos, 10)
+    # Same semantics as events._lecture_to_day for digit tails: 8
+    # digits = the calendar day; 9 digits inside the hash range = an
+    # already-hashed code round-tripping.  Everything else (short
+    # tails, out-of-range codes, non-digit ids) needs murmur3 — the
+    # row fallback owns those.
+    np.logical_and(ok, (dwidth == 8)
+                   | ((dwidth == 9) & (dayval >= _HASH_DAY_BASE)
+                      & (dayval < _HASH_DAY_LIMIT)), out=ok)
+    pos = check_lit(pos, _L_VALID)
+    vw = gather2(pos, 5)
+    is_true = ((vw[:, :4]
+                == np.frombuffer(_L_TRUE, np.uint8)).all(axis=1))
+    is_false = ((vw == np.frombuffer(_L_FALSE, np.uint8)).all(axis=1))
+    np.logical_and(ok, is_true | is_false, out=ok)
+    pos = pos + np.where(is_true, len(_L_TRUE), len(_L_FALSE))
+    pos = check_lit(pos, _L_ETYPE)
+    ew = gather2(pos, 5)
+    is_entry = ((ew == np.frombuffer(_L_ENTRY, np.uint8)).all(axis=1))
+    is_exit = ((ew[:, :4]
+                == np.frombuffer(_L_EXIT, np.uint8)).all(axis=1))
+    np.logical_and(ok, is_entry | is_exit, out=ok)
+    pos = pos + np.where(is_exit, len(_L_EXIT), len(_L_ENTRY))
+    pos = check_lit(pos, _L_END)
+    np.logical_and(ok, pos == ends, out=ok)
+
+    student[:] = np.where(ok, sid & 0xFFFFFFFF, 0).astype(np.uint32)
+    day[:] = np.where(ok, dayval, 0).astype(np.uint32)
+    micros[:] = np.where(ok, ts_us, 0)
+    valid[:] = ok & is_true
+    etype[:] = np.where(ok & is_exit, 1, 0).astype(np.int8)
+
+    misses = np.nonzero(~ok)[0]
+    for i in misses.tolist():
+        # The exact Python codec for non-fast-shape payloads — raises
+        # on malformed JSON, like every decode in events.py.
+        row = columns_from_events([decode_event(bytes(payloads[i]))])
+        student[i] = row["student_id"][0]
+        day[i] = row["lecture_day"][0]
+        micros[i] = row["micros"][0]
+        valid[i] = row["is_valid"][0]
+        etype[i] = row["event_type"][0]
+    return cols
+
+
+__all__: List[str] = [
+    "IngressCodec", "JsonCodec", "BinaryCodec", "CODECS", "get_codec",
+    "codec_for_frame", "decode_frame", "frame_event_count",
+    "merge_columns", "scan_json_batch_columns", "COLUMN_KEYS",
+]

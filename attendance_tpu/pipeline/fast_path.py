@@ -65,7 +65,7 @@ from attendance_tpu.models.fused import (
     pick_delta_width)
 from attendance_tpu.models.hll import (
     best_histogram, estimate_from_histogram)
-from attendance_tpu.pipeline.events import decode_binary_batch
+from attendance_tpu.pipeline.codec import decode_frame
 from attendance_tpu.pipeline.processor import ProcessorMetrics
 from attendance_tpu.storage.columnar_store import ColumnarEventStore
 from attendance_tpu.transport import (
@@ -155,8 +155,18 @@ class FusedPipeline:
         from attendance_tpu import chaos
         self._chaos = chaos.ensure(self.config)
         self.client = client or make_client(self.config)
-        self.consumer = self.client.subscribe(
-            self.config.pulsar_topic, self.SUBSCRIPTION)
+        if getattr(self.config, "ingress_lanes", 0) > 0:
+            # Striped ingress plane (pipeline.lanes): N lane sessions
+            # + bridge workers behind the one-consumer call shape this
+            # run loop speaks; acks (incl. the snapshot writer's group
+            # commits) route back to each owning lane's session.
+            from attendance_tpu.pipeline.lanes import StripedConsumer
+            self.consumer = StripedConsumer(
+                self.config, self.client, self.config.pulsar_topic,
+                self.SUBSCRIPTION, obs=self._obs)
+        else:
+            self.consumer = self.client.subscribe(
+                self.config.pulsar_topic, self.SUBSCRIPTION)
         from attendance_tpu.storage import wrap_store
         self.store = wrap_store(store or ColumnarEventStore(),
                                 self.config, sink="columnar")
@@ -461,8 +471,11 @@ class FusedPipeline:
         obs_t = self._obs
         t0 = time.perf_counter()
         # Skip the embedded ground-truth column: validity is recomputed
-        # on device and the store gets the computed vector.
-        cols = decode_binary_batch(data, include_truth=False)
+        # on device and the store gets the computed vector. The codec
+        # seam sniffs the wire (binary frames keep the exact zero-copy
+        # decode; JSON payloads arrive via the json codec), so new
+        # wires slot in as codecs, not hot-loop branches.
+        cols = decode_frame(data, include_truth=False)
         t_dec = time.perf_counter() if obs_t is not None else 0.0
         n = len(cols["student_id"])
         if n == 0:
@@ -2023,5 +2036,9 @@ class FusedPipeline:
         # shut the writer thread down.
         self._flush_snapshots()
         self._stop_snap_writer()
+        if hasattr(self.consumer, "lanes"):
+            # Striped ingress: stop the lane workers (and their owned
+            # sessions) before the client sweep below.
+            self.consumer.close()
         self.client.close()
         self.store.close()

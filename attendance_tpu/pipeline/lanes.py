@@ -1,0 +1,624 @@
+"""Striped parallel ingress: N lane workers feeding one dispatcher.
+
+One socket lane on one host core caps the realistic deployment path at
+~0.3% of device capacity (ROADMAP open item 1).  This module stripes
+ingress across ``--ingress-lanes N`` independent lanes:
+
+  * each lane owns its OWN broker session — for the socket backend a
+    dedicated TCP connection per lane (``SocketClient.subscribe_lane``),
+    so lane sessions reconnect, resume, and take over independently
+    (the PR 5 chaos semantics hold per lane);
+  * a per-lane **bridge worker** thread drains that session in
+    micro-batches and runs the codec seam's decode stage
+    (``pipeline.codec``) off the dispatch thread — JSON chunks decode
+    through the batch scanner, binary frames pass through raw
+    (zero-copy, no repack);
+  * workers hand blocks to the dispatcher through a bounded, lock-light
+    SPSC queue per lane (one deque + two semaphores: ``append`` /
+    ``popleft`` are atomic, the semaphores carry the bounds, and no
+    lock is ever held across a blocking operation);
+  * the single **dispatcher** (:class:`StripedConsumer`, the consumer
+    call-shape the fused run loop already speaks) coalesces blocks
+    ACROSS lanes into full device batches, so a slow or partial lane
+    never shrinks the dispatch size.
+
+Ack routing preserves the at-least-once and group-commit contracts:
+every coalesced frame remembers which lane each constituent message
+came from, acks/nacks route back to the owning lane's session, and the
+snapshot writer's group commit (PR 4) releases a barrier interval's
+frames across all lanes at once — a frame is never acknowledged before
+its barrier group is durable, whichever lane carried it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from attendance_tpu.pipeline import codec as codec_mod
+from attendance_tpu.transport import collect_batch, handle_poison
+from attendance_tpu.transport.memory_broker import Message, ReceiveTimeout
+
+logger = logging.getLogger(__name__)
+
+_POLL_S = 0.02  # dispatcher wait slice while every lane queue is empty
+
+
+class _LaneQueue:
+    """Bounded SPSC block queue: deque append/popleft are atomic under
+    the GIL, and the two semaphores carry the capacity/occupancy
+    handshake — neither side ever holds a lock while blocked.
+    ``wake`` is the dispatcher's shared doorbell: every put sets it, so
+    the dispatcher parks on one event instead of polling N queues."""
+
+    def __init__(self, depth: int, wake: threading.Event):
+        self._q: deque = deque()
+        self._slots = threading.Semaphore(depth)
+        self._items = threading.Semaphore(0)
+        self._wake = wake
+
+    def put(self, item, *, stop) -> bool:
+        """Producer side; returns False when ``stop`` fired while the
+        queue was full (the block is dropped — its messages were never
+        acked and will redeliver)."""
+        while not self._slots.acquire(timeout=0.1):
+            if stop.is_set():
+                return False
+        self._q.append(item)
+        self._items.release()
+        self._wake.set()
+        return True
+
+    def get(self, timeout_s: float):
+        if not self._items.acquire(timeout=timeout_s):
+            return None
+        item = self._q.popleft()
+        self._slots.release()
+        return item
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+
+class _Block:
+    """One decoded (or raw pass-through) micro-batch from one lane."""
+
+    __slots__ = ("lane", "cols", "raw", "n", "acks", "raw_acks",
+                 "chunks", "props", "redeliveries", "t_rx", "key")
+
+    def __init__(self, lane: int, *, cols=None, raw=None, n: int,
+                 acks, raw_acks: bool, chunks=None, props,
+                 redeliveries: int, t_rx: float, key=None):
+        self.lane = lane
+        # Stable identity across redeliveries: broker message ids, NOT
+        # id(self) — PoisonTracker counts a frame's OWN failures by
+        # this key, and an object id changes every redelivery (the
+        # count would never accumulate) and can be REUSED after gc (a
+        # healthy frame would inherit a poisoned frame's count).
+        self.key = key
+        self.cols = cols        # column dict (decoded wires)
+        self.raw = raw          # undecoded binary frame bytes
+        self.n = n
+        self.acks = acks        # lane-local ack tokens (raw tuples)
+        self.raw_acks = raw_acks
+        self.chunks = chunks    # chunk-lane (chunk_id, tuples) handles
+        self.props = props
+        self.redeliveries = redeliveries
+        self.t_rx = t_rx
+
+
+class LaneMessage:
+    """Message call-shape for one coalesced dispatch frame.  ``data()``
+    is the canonical planar block (or the single raw frame passed
+    through); acks/nacks fan back out to each owning lane."""
+
+    __slots__ = ("_data", "parts", "message_id", "redelivery_count",
+                 "_props")
+
+    def __init__(self, data: bytes, parts: List[Tuple[int, "_Block"]],
+                 redeliveries: int, props):
+        self._data = data
+        self.parts = parts  # [(lane_index, block), ...]
+        self.message_id = tuple(block.key for _, block in parts)
+        self.redelivery_count = redeliveries
+        self._props = props
+
+    def data(self) -> bytes:
+        return self._data
+
+    def properties(self):
+        return self._props
+
+
+class IngressLane:
+    """One lane: an owned broker session plus its bridge worker."""
+
+    def __init__(self, index: int, consumer, config, queue_depth: int,
+                 batch_size: int, obs=None, stop: threading.Event = None,
+                 decode_engine: str = "auto",
+                 wake: Optional[threading.Event] = None):
+        self.index = index
+        self.consumer = consumer
+        self.config = config
+        self.queue = _LaneQueue(queue_depth, wake or threading.Event())
+        self._batch = batch_size
+        self._stop = stop
+        self._obs = obs
+        self._tracer = obs.tracer if obs is not None else None
+        self._decode_engine = decode_engine
+        # Lane receive prefers the CHUNK lane (whole batches tracked as
+        # ONE broker in-flight entry, settled wholesale) — per-message
+        # broker bookkeeping is the dominant ingress cost at JSON-wire
+        # rates (the bridge learned this in PR 4); the raw lane and the
+        # Message path are the fallbacks, like bridge._drain.
+        self._chunk_lane = hasattr(consumer, "receive_chunk")
+        self._raw_lane = (not self._chunk_lane
+                          and hasattr(consumer, "receive_many_raw"))
+        # Events per message, adapted per block (_collect_chunks): 1
+        # on JSON wires, a whole frame on bulk-binary wires. 0 =
+        # unknown (nothing received yet): the first request asks for
+        # ONE message, so a bulk-binary backlog can never arrive as a
+        # single monster chunk before the estimate exists (that would
+        # collapse the snapshot cadence into one giant batch and
+        # compile a fresh padded shape).
+        self._ev_per_msg = 0
+        # Both the chunk and raw lanes hand back raw (mid, data,
+        # redeliveries, props) tuples; only the Message fallback wraps.
+        self._raw_toks = self._chunk_lane or self._raw_lane
+        from attendance_tpu.transport import PoisonTracker
+        self._poison = PoisonTracker()
+        # Async settlement: acks/nacks from the dispatcher (and the
+        # snapshot writer's group commits) are QUEUED here and
+        # performed by the worker between receives — the lane's
+        # connection has exactly one user, so a settlement never parks
+        # behind an in-flight receive round (measured: synchronous
+        # cross-thread acks cost ~10x a quiet ack and were the striped
+        # plane's largest overhead). Deferring an ack is free under
+        # at-least-once: a crash before the queued ack goes out
+        # redelivers the frames, exactly like a crash just before a
+        # synchronous ack.
+        self._settle_q: deque = deque()
+        self.metrics_events = 0
+        self.metrics_blocks = 0
+        if obs is not None:
+            lane = str(index)
+            self._c_events = obs.registry.counter(
+                "attendance_ingress_lane_events_total",
+                help="Events ingested per ingress lane", lane=lane)
+            q = self.queue
+            obs.registry.gauge(
+                "attendance_ingress_lane_queue_depth",
+                help="Decoded blocks parked in each lane's SPSC queue",
+                lane=lane).set_function(lambda q=q: float(len(q)))
+        else:
+            self._c_events = None
+        self.thread = threading.Thread(
+            target=self._run, name=f"ingress-lane-{index}", daemon=True)
+
+    # -- worker --------------------------------------------------------------
+    # One server wait round per lane receive RPC — bounded so queued
+    # settlements (drained between rounds) and teardown never wait out
+    # the server's 10s cap.
+    _RPC_WAIT_MS = 50
+
+    def _drain_settlements(self) -> None:
+        """Perform queued acks/nacks on this worker's own connection
+        (the only user of the lane channel — see _settle_q)."""
+        while self._settle_q:
+            op, block = self._settle_q.popleft()
+            try:
+                if op == "ack":
+                    self._ack_now(block)
+                else:
+                    self._nack_now(block)
+            except Exception:
+                # Broker gone / session churn: the frames were either
+                # settled server-side already or will redeliver —
+                # at-least-once either way.
+                logger.warning("lane %d deferred %s failed "
+                               "(frames will redeliver)",
+                               self.index, op, exc_info=True)
+
+    def _collect_chunks(self) -> list:
+        """collect_chunks with two lane-specific bounds.
+
+        (1) Per-RPC server waits are short (_RPC_WAIT_MS) with a yield
+        between empty rounds, so settlement RPCs (chunk acks, the
+        snapshot writer's group commits) sharing this connection are
+        never starved by a tight re-receive loop or parked behind a
+        long server wait.
+
+        (2) The request size is denominated in EVENTS, not messages:
+        a bulk-binary topic carries whole frames per message, and a
+        message-count request would pull an entire backlog into one
+        monster block (new padded shape -> compile churn, and one lane
+        starves its siblings). ``_ev_per_msg`` adapts from the last
+        block, so JSON topics (1 event/message) still fill full
+        micro-batches in one RPC."""
+        chunks: list = []
+        total_msgs = 0
+        total_events = 0
+        deadline = time.monotonic() + self.config.batch_timeout_s
+        while (total_events < self._batch
+               and not self._stop.is_set()):
+            rem_ms = int((deadline - time.monotonic()) * 1000)
+            if rem_ms <= 0 and total_msgs:
+                break
+            if self._ev_per_msg == 0:
+                want = 1  # size unknown: learn from one message
+            else:
+                want = max(1, (self._batch - total_events)
+                           // self._ev_per_msg)
+            try:
+                cid, toks = self.consumer.receive_chunk(
+                    want, timeout_millis=min(max(rem_ms, 1),
+                                             self._RPC_WAIT_MS))
+            except ReceiveTimeout:
+                self._drain_settlements()  # idle: settle promptly
+                if total_msgs:
+                    break
+                deadline = time.monotonic() + self.config.batch_timeout_s
+                continue
+            chunks.append((cid, toks))
+            if len(toks) < max(1, want // 4):
+                # The broker served a sliver (pop-on-nonempty racing a
+                # trickling publisher): linger a moment so the rest of
+                # the block arrives as ONE chunk instead of many — each
+                # extra chunk is an extra settlement RPC later, and
+                # that fragmentation was a measured ~6% parity tax on
+                # long streaming passes. Bounded by the deadline.
+                time.sleep(0.002)
+            total_msgs += len(toks)
+            # Event counting sniffs the CHUNK's first payload only:
+            # per-message sniffing here measurably taxes the JSON wire
+            # (this loop runs per message at wire rate), and a topic
+            # mixes wires only in tests — a mixed chunk just makes the
+            # request-size estimate approximate, never incorrect. A
+            # payload that LOOKS binary but won't parse (in-flight
+            # corruption) counts as one event: this is a sizing
+            # heuristic, and the poison path downstream owns the frame.
+            if codec_mod.codec_for_frame(toks[0][1]).name == "binary":
+                for tok in toks:
+                    try:
+                        total_events += codec_mod.frame_event_count(
+                            tok[1])
+                    except ValueError:
+                        total_events += 1
+            else:
+                total_events += len(toks)
+        if total_msgs:
+            self._ev_per_msg = max(1, total_events // total_msgs)
+        return chunks
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._drain_settlements()
+            chunks = None
+            try:
+                if self._chunk_lane:
+                    chunks = self._collect_chunks()
+                    toks = [t for _, ts in chunks for t in ts]
+                else:
+                    toks = collect_batch(
+                        self.consumer, self._batch,
+                        self.config.batch_timeout_s,
+                        raw=self._raw_lane)
+            except Exception:
+                if self._stop.is_set():
+                    return  # teardown severed the session: clean exit
+                logger.exception("ingress lane %d receive failed; "
+                                 "retrying", self.index)
+                time.sleep(0.05)
+                continue
+            if not toks:
+                continue
+            t_rx = time.perf_counter()
+            try:
+                block = self._decode(toks, t_rx, chunks)
+            except Exception:
+                block = self._decode_poison(toks, t_rx, chunks)
+            if block is None or block.n == 0:
+                continue
+            self.metrics_events += block.n
+            self.metrics_blocks += 1
+            if self._c_events is not None:
+                self._c_events.inc(block.n)
+            if not self.queue.put(block, stop=self._stop):
+                return
+
+    def _payload(self, tok):
+        return tok[1] if self._raw_toks else tok.data()
+
+    def _tok_props(self, tok):
+        if self._raw_toks:
+            return tok[3]
+        return tok.properties() if hasattr(tok, "properties") else None
+
+    def _tok_redeliveries(self, tok) -> int:
+        if self._raw_toks:
+            return tok[2]
+        from attendance_tpu.transport import redelivery_count
+        return redelivery_count(tok)
+
+    def _block_key(self, toks) -> tuple:
+        """Redelivery-stable block identity from broker message ids
+        (see _Block.key)."""
+        if self._raw_toks:
+            return (self.index, toks[0][0], toks[-1][0], len(toks))
+        return (self.index, toks[0].message_id,
+                toks[-1].message_id, len(toks))
+
+    def _decode(self, toks, t_rx: float, chunks=None) -> _Block:
+        payloads = [self._payload(t) for t in toks]
+        first = payloads[0]
+        props = self._tok_props(toks[0])
+        red = max(self._tok_redeliveries(t) for t in toks)
+        t0 = time.perf_counter()
+        if (len(payloads) == 1
+                and codec_mod.codec_for_frame(first).name == "binary"):
+            # Bulk binary frame: RAW pass-through — the dispatcher (and
+            # ultimately process_frame's zero-copy decode) never pays a
+            # repack for the already-canonical wire.
+            block = _Block(self.index, raw=first,
+                           n=codec_mod.frame_event_count(first),
+                           acks=toks, raw_acks=self._raw_toks,
+                           chunks=chunks, props=props,
+                           redeliveries=red, t_rx=t_rx,
+                           key=self._block_key(toks))
+        else:
+            wire = codec_mod.codec_for_frame(first)
+            prefer_vec = self._decode_engine == "vector"
+            if self._decode_engine == "auto":
+                # The native list scan is the fastest engine but HOLDS
+                # the GIL; without it the vectorized batch scanner
+                # beats the per-event Python codec severalfold.
+                from attendance_tpu.native import load as load_native
+                nat = load_native()
+                prefer_vec = not (nat is not None
+                                  and getattr(nat, "has_list_scan",
+                                              False))
+            cols = wire.decode(payloads, prefer_gil_release=prefer_vec)
+            block = _Block(self.index, cols=cols,
+                           n=len(cols["student_id"]), acks=toks,
+                           raw_acks=self._raw_toks, chunks=chunks,
+                           props=props, redeliveries=red, t_rx=t_rx,
+                           key=self._block_key(toks))
+        self._trace_decode(props, t0, block.n)
+        return block
+
+    def _decode_poison(self, toks, t_rx: float,
+                       chunks=None) -> Optional[_Block]:
+        """Batch decode failed: convert per message so only the poison
+        payloads dead-letter (the bridge's policy, per lane). Chunk
+        handles are EXPLODED into per-message in-flight entries first —
+        per-message ack/nack needs them, and the poison path is off the
+        steady-state budget by definition."""
+        from attendance_tpu.pipeline.events import (
+            columns_from_events, decode_event)
+
+        if chunks is not None:
+            for cid, _ in chunks:
+                self.consumer.explode_chunk(cid)
+        good_toks, parts = [], []
+        for tok in toks:
+            payload = self._payload(tok)
+            try:
+                if codec_mod.codec_for_frame(payload).name == "binary":
+                    parts.append(codec_mod.decode_frame(payload))
+                else:
+                    parts.append(columns_from_events(
+                        [decode_event(bytes(payload))]))
+                good_toks.append(tok)
+            except Exception:
+                msg = (Message(tok[1], tok[0], tok[2], tok[3])
+                       if self._raw_toks else tok)
+                handle_poison(msg, self.consumer, _NullMetrics(),
+                              self.config, logger, count_nack=False,
+                              tracker=self._poison)
+        if not good_toks:
+            return None
+        cols = codec_mod.merge_columns(parts)
+        props = self._tok_props(good_toks[0])
+        red = max(self._tok_redeliveries(t) for t in good_toks)
+        return _Block(self.index, cols=cols, n=len(cols["student_id"]),
+                      acks=good_toks, raw_acks=self._raw_toks,
+                      props=props, redeliveries=red, t_rx=t_rx,
+                      key=self._block_key(good_toks))
+
+    def _trace_decode(self, props, t0: float, n: int) -> None:
+        tr = self._tracer
+        if tr is None:
+            return
+        from attendance_tpu.obs.tracing import TRACEPARENT, parse_ctx
+        ctx = parse_ctx((props or {}).get(TRACEPARENT))
+        tr.add_span(
+            "lane_decode", t0, time.perf_counter(),
+            trace_id=ctx.trace_id if ctx is not None else tr.new_id(),
+            parent_id=ctx.span_id if ctx is not None else None,
+            role=f"ingress-lane-{self.index}",
+            args={"lane": self.index, "events": n})
+
+    # -- ack routing (dispatcher/writer threads enqueue; the worker
+    # -- performs — see _settle_q) ------------------------------------------
+    def ack(self, block: "_Block") -> None:
+        self._settle_q.append(("ack", block))
+
+    def nack(self, block: "_Block") -> None:
+        self._settle_q.append(("nack", block))
+
+    def _ack_now(self, block: "_Block") -> None:
+        if block.chunks is not None:
+            for cid, _ in block.chunks:
+                self.consumer.acknowledge_chunk(cid)
+        elif block.raw_acks:
+            self.consumer.acknowledge_ids([t[0] for t in block.acks])
+        else:
+            from attendance_tpu.transport import acknowledge_all
+            acknowledge_all(self.consumer, block.acks)
+
+    def _nack_now(self, block: "_Block") -> None:
+        if block.chunks is not None:
+            for cid, _ in block.chunks:
+                self.consumer.nack_chunk(cid)
+            return
+        for tok in block.acks:
+            msg = (Message(tok[1], tok[0], tok[2], tok[3])
+                   if block.raw_acks else tok)
+            self.consumer.negative_acknowledge(msg)
+
+
+class _NullMetrics:
+    """handle_poison's metrics shape for lane workers (dead_lettered
+    counts surface through the obs counters, not ProcessorMetrics)."""
+
+    dead_lettered = 0
+    nacked_batches = 0
+
+
+class StripedConsumer:
+    """N-lane ingress behind the single-consumer call shape the fused
+    run loop speaks (``receive`` / ``acknowledge`` /
+    ``negative_acknowledge`` / ``acknowledge_many``).
+
+    ``receive`` coalesces ready lane blocks into one canonical frame of
+    up to ``dispatch_size`` events; a lone raw binary block passes
+    through without a repack (single-lane parity: byte-identical frames
+    to the unstriped path)."""
+
+    def __init__(self, config, client, topic: str, subscription: str,
+                 *, num_lanes: Optional[int] = None, obs=None,
+                 dispatch_size: Optional[int] = None,
+                 decode_engine: Optional[str] = None):
+        self.config = config
+        num_lanes = num_lanes or max(
+            1, getattr(config, "ingress_lanes", 0))
+        depth = max(1, getattr(config, "lane_queue_depth", 4))
+        self._dispatch_size = dispatch_size or config.batch_size
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._rr = itertools.cycle(range(num_lanes))
+        engine = (decode_engine
+                  or getattr(config, "lane_decode", "auto"))
+        lane_batch = max(1, self._dispatch_size)
+        self.lanes: List[IngressLane] = []
+        subscribe_lane = getattr(client, "subscribe_lane", None)
+        for i in range(num_lanes):
+            consumer = (subscribe_lane(topic, subscription, i)
+                        if subscribe_lane is not None
+                        else client.subscribe(topic, subscription))
+            self.lanes.append(IngressLane(
+                i, consumer, config, depth, lane_batch, obs=obs,
+                stop=self._stop, decode_engine=engine,
+                wake=self._wake))
+        for lane in self.lanes:
+            lane.thread.start()
+
+    # -- dispatcher ---------------------------------------------------------
+    def _pop_ready(self) -> List["_Block"]:
+        """Grab ready blocks round-robin across lanes until the
+        dispatch target is met or every queue is momentarily dry."""
+        blocks: List[_Block] = []
+        total = 0
+        dry = 0
+        lane_iter = self._rr
+        n_lanes = len(self.lanes)
+        while total < self._dispatch_size and dry < n_lanes:
+            lane = self.lanes[next(lane_iter)]
+            block = lane.queue.get(0.0)
+            if block is None:
+                dry += 1
+                continue
+            dry = 0
+            blocks.append(block)
+            total += block.n
+        return blocks
+
+    def receive(self, timeout_millis: Optional[int] = None
+                ) -> LaneMessage:
+        deadline = (None if timeout_millis is None
+                    else time.monotonic() + timeout_millis / 1e3)
+        while True:
+            # Clear-then-scan ordering makes the doorbell race-free: a
+            # put between the scan and the wait re-sets the event, so
+            # the wait below returns immediately instead of sleeping
+            # out its slice on a ready queue.
+            self._wake.clear()
+            blocks = self._pop_ready()
+            if blocks:
+                return self._coalesce(blocks)
+            if self._stop.is_set():
+                raise RuntimeError("striped consumer closed")
+            if deadline is not None:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    raise ReceiveTimeout(
+                        f"no lane block within {timeout_millis}ms")
+                self._wake.wait(min(_POLL_S, rem))
+            else:
+                self._wake.wait(_POLL_S)
+
+    def _coalesce(self, blocks: Sequence["_Block"]) -> LaneMessage:
+        parts = [(b.lane, b) for b in blocks]
+        red = max(b.redeliveries for b in blocks)
+        props = blocks[0].props
+        if len(blocks) == 1 and blocks[0].raw is not None:
+            return LaneMessage(blocks[0].raw, parts, red, props)
+        cols = codec_mod.merge_columns([
+            b.cols if b.cols is not None
+            else codec_mod.decode_frame(b.raw) for b in blocks])
+        if "is_valid" not in cols:
+            # Decoded hot-path blocks may omit the generator's ground
+            # truth; the canonical planar frame carries a zero flag
+            # column (the dispatcher recomputes validity on device).
+            cols = dict(cols)
+            cols["is_valid"] = np.zeros(len(cols["student_id"]), bool)
+        data = codec_mod.CODECS["binary"].assemble(cols)
+        return LaneMessage(data, parts, red, props)
+
+    # -- consumer call-shape ------------------------------------------------
+    def acknowledge(self, msg: LaneMessage) -> None:
+        for lane_idx, block in msg.parts:
+            self.lanes[lane_idx].ack(block)
+
+    def acknowledge_many(self, msgs) -> None:
+        for msg in msgs:
+            self.acknowledge(msg)
+
+    def negative_acknowledge(self, msg: LaneMessage) -> None:
+        for lane_idx, block in msg.parts:
+            self.lanes[lane_idx].nack(block)
+
+    def backlog(self) -> int:
+        return sum(lane.consumer.backlog() for lane in self.lanes)
+
+    def lane_event_totals(self) -> List[int]:
+        return [lane.metrics_events for lane in self.lanes]
+
+    def close(self) -> None:
+        # Order matters: stop and JOIN the workers before closing any
+        # session. A still-running sibling worker would immediately
+        # re-receive the messages a closing consumer's takeover just
+        # requeued — and a chunk received on a session after its own
+        # close()'s requeue ran is stranded in-flight forever (its
+        # owner never closes again).
+        self._stop.set()
+        for lane in self.lanes:
+            lane.thread.join(timeout=5.0)
+        for lane in self.lanes:
+            # Flush settlements the worker didn't get to (the frames
+            # are settled server-side or redeliver; this just keeps a
+            # graceful close's acks from being dropped on the floor).
+            try:
+                lane._drain_settlements()
+            except Exception:
+                pass
+            try:
+                lane.consumer.close()
+            except Exception:
+                pass  # teardown: the broker may already be gone

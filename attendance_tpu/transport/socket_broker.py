@@ -585,7 +585,8 @@ class SocketConsumer:
                  owner: "Optional[SocketClient]" = None,
                  topic: str = "", subscription: str = "",
                  prefetch: int = PREFETCH,
-                 policy: Optional[RetryPolicy] = None):
+                 policy: Optional[RetryPolicy] = None,
+                 lane: Optional[int] = None):
         self._rpc = rpc
         self._handle = handle
         self._owns_rpc = owns_rpc
@@ -596,11 +597,14 @@ class SocketConsumer:
         self._policy = policy or RetryPolicy()
         self._session_gen = rpc.generation
         self._sub_body = _subscribe_body(topic, subscription)
+        self.lane = lane  # striped-ingress lane index (None = unlaned)
         self.resubscribes = 0
         from attendance_tpu import obs
         tel = obs.get()
         if tel is not None:
             labels = dict(topic=topic, subscription=subscription)
+            if lane is not None:
+                labels["lane"] = str(lane)
             self._obs_msgs = tel.registry.counter(
                 "attendance_socket_received_messages_total",
                 help="Messages received from the socket broker",
@@ -849,15 +853,17 @@ class SocketClient:
         return SocketProducer(self._rpc, topic, policy=self._policy)
 
     def subscribe(self, topic: str, subscription_name: str,
-                  consumer_type=None) -> SocketConsumer:
+                  consumer_type=None, *,
+                  lane: Optional[int] = None) -> SocketConsumer:
         del consumer_type  # shared semantics, like the memory broker
-        rpc = _Rpc(self._address, chaos=self._chaos,
-                   site="socket.consume")
+        site = ("socket.consume" if lane is None
+                else f"socket.consume.lane{lane}")
+        rpc = _Rpc(self._address, chaos=self._chaos, site=site)
         body = _subscribe_body(topic, subscription_name)
         try:
             status, reply = resilient_call(
                 rpc, lambda: (_OP_SUBSCRIBE, body),
-                site="socket.consume", policy=self._policy)
+                site=site, policy=self._policy)
             (handle,) = struct.unpack("<I", _check(status, reply))
         except BaseException:
             rpc.close()
@@ -865,9 +871,19 @@ class SocketClient:
         consumer = SocketConsumer(rpc, handle, owns_rpc=True, owner=self,
                                   topic=topic,
                                   subscription=subscription_name,
-                                  policy=self._policy)
+                                  policy=self._policy, lane=lane)
         self._consumers.add(consumer)
         return consumer
+
+    def subscribe_lane(self, topic: str, subscription_name: str,
+                       lane: int) -> SocketConsumer:
+        """Lane-affine subscribe for the striped ingress plane: the
+        lane gets its OWN TCP connection and session (reconnect,
+        resume, and crash takeover are per lane — one severed lane
+        never stalls its siblings), its own chaos/retry site
+        (``socket.consume.laneN``) so fault streams and retry spans
+        attribute to the lane, and lane-labeled traffic counters."""
+        return self.subscribe(topic, subscription_name, lane=lane)
 
     def close(self) -> None:
         # Fast teardown: sever every consumer's dedicated connection

@@ -72,11 +72,19 @@ def test_e2e_snapshot_smoke(tmp_path):
 
 
 def test_socket_smoke():
+    # strict=False: real bench runs hard-fail on a non-converged row
+    # (ISSUE 6 satellite); this smoke's windows are far too short to
+    # converge on a noisy host and only checks the plumbing.
     r = bench.bench_socket(batch_size=1024, seconds=0.2,
-                           capacity=10_000, num_banks=8)
+                           capacity=10_000, num_banks=8, strict=False)
     assert r["events_per_sec"] > 0
     assert r["events"] >= 1024
     assert ":" in r["broker_address"]
+    # Striped-lane columns ride the same broker (ISSUE 6 tentpole).
+    assert r["ingress_lanes"] == 4
+    assert r["striped_events_per_sec"] > 0
+    assert r["striped_json_events_per_sec"] > 0
+    assert sum(r["lane_event_totals"]) > 0
     # The JSON bridge lane rides the same TCP broker (VERDICT r04 #4).
     assert r["json_events_per_sec"] > 0
     assert r["json_events"] > 0
@@ -130,7 +138,10 @@ def test_main_emits_one_json_line(capsys, monkeypatch):
         sys, "argv",
         ["bench.py", "--seconds", "0.2", "--capacity", "10000",
          "--num-banks", "8", "--batch-size", "2048",
-         "--e2e-batch-size", "2048"])
+         "--e2e-batch-size", "2048",
+         # Smoke windows are too short to converge on a busy host;
+         # artifact runs keep the loud failure (ISSUE 6 satellite).
+         "--no-strict-convergence"])
     bench.main()
     out = capsys.readouterr().out.strip().splitlines()
     line = json.loads(out[-1])

@@ -1,0 +1,189 @@
+"""Codec-seam tests (ISSUE 6): every wire round-trips through the
+extracted decode -> assemble -> dispatch interface identically to the
+pre-refactor paths, and the vectorized batch scanner is differentially
+identical to the exact Python codec on every payload shape."""
+
+import numpy as np
+import pytest
+
+from attendance_tpu.pipeline import codec
+from attendance_tpu.pipeline.events import (
+    AttendanceEvent, columns_from_events, decode_binary_batch,
+    decode_event_batch, decode_json_batch_columns, encode_binary_batch,
+    encode_event, encode_planar_batch)
+from attendance_tpu.pipeline.loadgen import frame_from_columns, synth_columns
+
+COLS = ("student_id", "lecture_day", "micros", "is_valid", "event_type")
+
+
+def _events(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return [AttendanceEvent(
+        student_id=int(rng.integers(1, 1 << 31)),
+        timestamp=f"2026-07-{1 + int(rng.integers(0, 28)):02d}"
+                  f"T{int(rng.integers(0, 24)):02d}"
+                  f":{int(rng.integers(0, 60)):02d}"
+                  f":{int(rng.integers(0, 60)):02d}",
+        lecture_id=f"LECTURE_202607{1 + int(rng.integers(0, 28)):02d}",
+        is_valid=bool(rng.random() < 0.9),
+        event_type="exit" if rng.random() < 0.5 else "entry")
+        for _ in range(n)]
+
+
+def _assert_cols_equal(a, b, keys=COLS):
+    for k in keys:
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), k
+
+
+# ---------------------------------------------------------------------------
+# Round-trip identity vs the pre-refactor paths
+# ---------------------------------------------------------------------------
+
+def test_json_codec_matches_legacy_decode():
+    payloads = [encode_event(e) for e in _events()]
+    seam = codec.get_codec("json").decode(payloads)
+    legacy = decode_json_batch_columns(payloads)
+    _assert_cols_equal(seam, legacy)
+
+
+def test_json_codec_vector_engine_matches_python_codec():
+    payloads = [encode_event(e) for e in _events()]
+    seam = codec.get_codec("json").decode(payloads,
+                                          prefer_gil_release=True)
+    oracle = columns_from_events(decode_event_batch(payloads))
+    _assert_cols_equal(seam, oracle)
+
+
+@pytest.mark.parametrize("planar", [True, False])
+def test_binary_codec_matches_legacy_decode(planar):
+    rng = np.random.default_rng(1)
+    roster = rng.integers(10_000, 50_000, 500).astype(np.uint32)
+    cols = synth_columns(rng, 256, roster, num_lectures=8)
+    frame = frame_from_columns(cols, planar=planar)
+    seam = codec.get_codec("binary").decode([frame])
+    legacy = decode_binary_batch(frame)
+    _assert_cols_equal(seam, legacy)
+    # Multi-frame decode concatenates in payload order.
+    two = codec.get_codec("binary").decode([frame, frame])
+    for k in COLS:
+        assert np.array_equal(np.asarray(two[k]),
+                              np.concatenate([np.asarray(legacy[k])] * 2))
+
+
+def test_assemble_then_dispatch_decode_round_trips():
+    """decode -> assemble -> decode_frame (the dispatcher's entry) is
+    the identity for every codec."""
+    events = _events(48, seed=2)
+    json_payloads = [encode_event(e) for e in events]
+    bin_frame = encode_binary_batch(events)
+    for name, payloads in (("json", json_payloads),
+                           ("binary", [bin_frame])):
+        c = codec.get_codec(name)
+        cols = c.decode(payloads)
+        block = c.assemble(cols)
+        _assert_cols_equal(codec.decode_frame(block), cols)
+        hot = codec.decode_frame(block, include_truth=False)
+        assert "is_valid" not in hot
+        _assert_cols_equal(hot, cols,
+                           keys=[k for k in COLS if k != "is_valid"])
+
+
+def test_codec_sniffing_and_frame_event_count():
+    events = _events(8, seed=3)
+    json_payload = encode_event(events[0])
+    bin_frame = encode_binary_batch(events)
+    planar = encode_planar_batch(columns_from_events(events))
+    assert codec.codec_for_frame(json_payload).name == "json"
+    assert codec.codec_for_frame(bin_frame).name == "binary"
+    assert codec.codec_for_frame(planar).name == "binary"
+    assert codec.frame_event_count(bin_frame) == len(events)
+    assert codec.frame_event_count(planar) == len(events)
+    with pytest.raises(ValueError):
+        codec.frame_event_count(json_payload)
+    with pytest.raises(KeyError):
+        codec.get_codec("carrier-pigeon")
+
+
+def test_decode_frame_json_payload():
+    e = _events(1, seed=4)[0]
+    cols = codec.decode_frame(encode_event(e))
+    oracle = columns_from_events([e])
+    _assert_cols_equal(cols, oracle)
+
+
+def test_merge_columns_concatenates():
+    events = _events(10, seed=5)
+    a = columns_from_events(events[:4])
+    b = columns_from_events(events[4:])
+    merged = codec.merge_columns([a, b])
+    _assert_cols_equal(merged, columns_from_events(events))
+    assert codec.merge_columns([a]) is a
+
+
+# ---------------------------------------------------------------------------
+# Vectorized batch scanner: differential vs the exact Python codec
+# ---------------------------------------------------------------------------
+
+FALLBACK_PAYLOADS = [
+    # timezone suffix -> row fallback
+    b'{"student_id": 7, "timestamp": "2026-07-14T08:30:00Z", '
+    b'"lecture_id": "LECTURE_20260714", "is_valid": true, '
+    b'"event_type": "entry"}',
+    # non-6-digit fraction
+    b'{"student_id": 8, "timestamp": "2026-07-14T08:30:00.12", '
+    b'"lecture_id": "LECTURE_20260714", "is_valid": true, '
+    b'"event_type": "entry"}',
+    # non-digit lecture tail (murmur3 hashing path)
+    b'{"student_id": 9, "timestamp": "2026-07-14T08:30:00", '
+    b'"lecture_id": "LECTURE_X", "is_valid": false, '
+    b'"event_type": "entry"}',
+    # non-LECTURE prefix
+    b'{"student_id": 10, "timestamp": "2026-07-14T08:30:00", '
+    b'"lecture_id": "SEMINAR_99", "is_valid": false, '
+    b'"event_type": "exit"}',
+    # 9-digit already-hashed code round-trip (fast shape)
+    b'{"student_id": 11, "timestamp": "2026-07-14T08:30:00", '
+    b'"lecture_id": "LECTURE_123456789", "is_valid": true, '
+    b'"event_type": "exit"}',
+    # reordered keys -> fallback
+    b'{"timestamp": "2026-07-14T08:30:00", "student_id": 12, '
+    b'"lecture_id": "LECTURE_20260714", "is_valid": true, '
+    b'"event_type": "entry"}',
+    # compact separators (non-default json.dumps) -> fallback
+    b'{"student_id":13,"timestamp":"2026-07-14T08:30:00",'
+    b'"lecture_id":"LECTURE_20260714","is_valid":true,'
+    b'"event_type":"entry"}',
+]
+
+
+def test_vector_scanner_differential_mixed_shapes():
+    fast = [encode_event(e) for e in _events(40, seed=6)]
+    frac = [encode_event(AttendanceEvent(
+        5, "2026-01-02T23:59:59.123456", "LECTURE_20260102", False,
+        "exit"))]
+    payloads = fast[:10] + FALLBACK_PAYLOADS + fast[10:] + frac
+    got = codec.scan_json_batch_columns(payloads)
+    oracle = columns_from_events(decode_event_batch(payloads))
+    _assert_cols_equal(got, oracle)
+
+
+def test_vector_scanner_empty_and_bounds():
+    empty = codec.scan_json_batch_columns([])
+    assert all(len(empty[k]) == 0 for k in COLS)
+    # uint32 extremes and minimal ids
+    payloads = [
+        b'{"student_id": 0, "timestamp": "1970-01-01T00:00:00", '
+        b'"lecture_id": "LECTURE_19700101", "is_valid": false, '
+        b'"event_type": "entry"}',
+        b'{"student_id": 4294967295, "timestamp": '
+        b'"2099-12-31T23:59:59", "lecture_id": "LECTURE_20991231", '
+        b'"is_valid": true, "event_type": "exit"}',
+    ]
+    got = codec.scan_json_batch_columns(payloads)
+    oracle = columns_from_events(decode_event_batch(payloads))
+    _assert_cols_equal(got, oracle)
+
+
+def test_vector_scanner_raises_on_malformed_json():
+    with pytest.raises(Exception):
+        codec.scan_json_batch_columns([b"not json at all"])
