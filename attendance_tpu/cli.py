@@ -310,6 +310,59 @@ def cmd_pipeline(args) -> None:
     processor.cleanup()
 
 
+def cmd_serve(args) -> None:
+    """Standalone query-serving reader: merge-on-read over a snapshot
+    directory's base+delta chain (never joining the ingest process),
+    publishing fresh epochs as the writer publishes durable state, and
+    answering the query verbs over the binary batch RPC — plus JSON
+    routes on --metrics-port when telemetry is live. This is the
+    separate-process read replica of ROADMAP item 2 (and the serving
+    surface item 4's federated replicas will use)."""
+    import sys
+    import time as _time
+
+    from attendance_tpu import obs
+    from attendance_tpu.serve.chain import ChainEpochSource
+    from attendance_tpu.serve.engine import QueryEngine
+    from attendance_tpu.serve.rpc import QueryServer
+
+    config = config_from_args(args)
+    if not config.snapshot_dir:
+        logger.error("serve needs --snapshot-dir (the chain to read)")
+        sys.exit(2)
+    telemetry = obs.ensure(config)
+    try:
+        source = ChainEpochSource(config.snapshot_dir,
+                                  refresh_s=args.refresh_s,
+                                  obs=telemetry).start()
+    except FileNotFoundError as e:
+        logger.error("no snapshot chain to serve: %s", e)
+        sys.exit(2)
+    engine = QueryEngine(
+        source, obs=telemetry, batch_max=config.query_batch_max,
+        staleness_ceiling_s=config.read_staleness_ceiling_s or None)
+    port = config.serve_port
+    server = QueryServer(engine, port=0 if port < 0 else port).start()
+    if telemetry is not None and telemetry._server is not None:
+        from attendance_tpu.serve import http as serve_http
+        serve_http.attach(telemetry._server, engine)
+    epoch = source.pin()
+    print(f"query plane serving {config.snapshot_dir} on "
+          f"{server.address} (epoch {epoch.seq}, "
+          f"{epoch.events} events)", flush=True)
+    try:
+        if args.serve_seconds is not None:
+            _time.sleep(args.serve_seconds)
+        else:
+            while True:
+                _time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        source.stop()
+
+
 def cmd_telemetry(args) -> None:
     """Pretty-print a telemetry artifact: a flight-recorder JSON dump
     (``kill -USR1`` / crash / --flight-path), a Prometheus exposition
@@ -379,6 +432,8 @@ def cmd_doctor(args) -> None:
             snapshot_stall_ceiling=args.snapshot_stall_ceiling,
             max_reconnects=args.max_reconnects,
             lane_skew_ceiling=args.lane_skew_ceiling,
+            query_p99_ceiling=args.query_p99_ceiling,
+            staleness_ceiling=args.staleness_ceiling,
             quarantine_dir=args.quarantine)
     except FileNotFoundError as e:
         logger.error("no such artifact: %s", e)
@@ -486,6 +541,21 @@ def main(argv=None) -> None:
     p_br.add_argument("--idle-timeout-s", type=float, default=1.0)
     p_br.set_defaults(fn=cmd_bridge)
 
+    p_srv = sub.add_parser(
+        "serve", help="standalone query-serving reader over a "
+        "snapshot directory's base+delta chain: BF.EXISTS/PFCOUNT/"
+        "occupancy/attendance-rate on the --serve-port binary RPC "
+        "(and /query/* JSON routes when --metrics-port is live), "
+        "refreshing epochs as the ingest writer publishes")
+    add_flags(p_srv)
+    p_srv.add_argument("--refresh-s", type=float, default=1.0,
+                       help="chain-manifest poll cadence (read "
+                       "staleness = barrier cadence + this)")
+    p_srv.add_argument("--serve-seconds", type=float, default=None,
+                       help="exit after this long (default: serve "
+                       "until interrupted)")
+    p_srv.set_defaults(fn=cmd_serve)
+
     p_tel = sub.add_parser(
         "telemetry", help="pretty-print a flight-recorder dump, a "
         "--metrics-prom exposition file, or a --trace-out span trace "
@@ -524,6 +594,14 @@ def main(argv=None) -> None:
                        "recovered from the prom artifact — 0.5 flags "
                        "a lane running under half the median (dead-"
                        "lane detection); omitted = informational row")
+    p_doc.add_argument("--query-p99-ceiling", type=float, default=None,
+                       help="gate the query-stage latency p99 "
+                       "(seconds) recovered from the prom histograms; "
+                       "omitted = informational row")
+    p_doc.add_argument("--staleness-ceiling", type=float, default=None,
+                       help="gate attendance_read_staleness_seconds "
+                       "(the published read epoch's age at the last "
+                       "scrape); omitted = informational row")
     p_doc.add_argument("--quarantine", default="",
                        help="list this on-disk dead-letter quarantine "
                        "in the verdict table")

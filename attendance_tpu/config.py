@@ -227,6 +227,26 @@ class Config:
     # chaos_seed, so a failing run replays from its seed.
     chaos: str = ""
     chaos_seed: int = 0
+    # Live query-serving plane (attendance_tpu/serve): when nonzero,
+    # the fused pipeline answers BF.EXISTS / PFCOUNT / occupancy /
+    # attendance-rate queries from an epoch-pinned host mirror of the
+    # sketch state — snapshot-isolated reads that never touch the
+    # device hot loop — over a length-prefixed binary batch RPC on
+    # this port (-1 = ephemeral, exposed as pipeline.query_server.port)
+    # plus JSON routes on the --metrics-port HTTP endpoint. Epochs are
+    # published at snapshot barriers (and preload/restore), so serving
+    # live state needs checkpointing on; without it the epoch stays at
+    # the preload/restore state until publish_epoch() is called.
+    serve_port: int = 0
+    # Largest key/day batch one query RPC may carry (the server rejects
+    # bigger ones; the client chunks transparently).
+    query_batch_max: int = 1 << 16
+    # Read-staleness objective (seconds; 0 = off): adds a
+    # `read_staleness<=X` SLO over the attendance_read_staleness_seconds
+    # gauge (the published epoch's age — bounded by the snapshot
+    # barrier cadence when serving from a live pipeline, barrier +
+    # refresh cadence from a chain reader).
+    read_staleness_ceiling_s: float = 0.0
     # Total retry budget for one logical broker RPC over the socket
     # transport: transient failures reconnect + retry with jittered
     # exponential backoff inside this window, then surface ONE
@@ -297,6 +317,16 @@ class Config:
             ChaosSpec.parse(self.chaos)
         if self.retry_budget_s <= 0:
             raise ValueError("retry_budget_s must be positive")
+        if not (-1 <= self.serve_port <= 65535):
+            raise ValueError(
+                f"serve_port out of range: {self.serve_port} "
+                "(0 = off, -1 = ephemeral)")
+        if self.query_batch_max < 1:
+            raise ValueError("query_batch_max must be >= 1")
+        if self.read_staleness_ceiling_s < 0:
+            raise ValueError(
+                "read_staleness_ceiling_s must be >= 0 (0 = no "
+                "staleness objective)")
         if self.persist_breaker_failures <= 0:
             raise ValueError("persist_breaker_failures must be positive")
         if self.persist_breaker_cooldown_s <= 0:
@@ -408,6 +438,19 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
     p.add_argument("--chaos-seed", type=int, default=d.chaos_seed,
                    help="master seed of the per-(site,fault) fault "
                    "streams — replay a failing chaos run from its seed")
+    p.add_argument("--serve-port", type=int, default=d.serve_port,
+                   help="serve the live query plane (BF.EXISTS/"
+                   "PFCOUNT/occupancy/rate from the epoch-pinned "
+                   "read mirror) on this binary RPC port "
+                   "(0 = off, -1 = ephemeral)")
+    p.add_argument("--query-batch-max", type=int,
+                   default=d.query_batch_max,
+                   help="largest key/day batch one query RPC may "
+                   "carry")
+    p.add_argument("--read-staleness-ceiling-s", type=float,
+                   default=d.read_staleness_ceiling_s,
+                   help="SLO ceiling on the published read epoch's "
+                   "age (0 = no objective)")
     p.add_argument("--retry-budget-s", type=float,
                    default=d.retry_budget_s,
                    help="total reconnect+retry window per broker RPC "
@@ -501,6 +544,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
         chaos=args.chaos,
         chaos_seed=args.chaos_seed,
         retry_budget_s=args.retry_budget_s,
+        serve_port=args.serve_port,
+        query_batch_max=args.query_batch_max,
+        read_staleness_ceiling_s=args.read_staleness_ceiling_s,
         persist_spill_dir=args.persist_spill_dir,
         persist_breaker_failures=args.persist_breaker_failures,
         persist_breaker_cooldown_s=args.persist_breaker_cooldown_s,

@@ -309,6 +309,44 @@ def bloom_contains_words(words: jax.Array, keys: jax.Array,
     return jnp.all((probes >> bit) & jnp.uint32(1) == jnp.uint32(1), axis=1)
 
 
+def bloom_contains_words_np(words: np.ndarray, keys: np.ndarray,
+                            params: BloomParams) -> np.ndarray:
+    """Numpy mirror of :func:`bloom_contains_words` — bit-identical
+    membership answers against a HOST copy of the packed filter.
+
+    This is the query plane's batched read entry point
+    (attendance_tpu/serve): point queries are answered from the
+    epoch-pinned host mirror with one vectorized probe pass over the
+    whole key batch — no device dispatch, no lock against the hot
+    loop. Probe positions come from the shared ``bloom_positions_np``,
+    so host and device answers can never diverge."""
+    words = np.asarray(words, dtype=np.uint32)
+    keys = np.asarray(keys, dtype=np.uint32)
+    if len(keys) == 0:
+        return np.zeros(0, dtype=bool)
+    pos = bloom_positions_np(keys, params).astype(np.int64)
+    probes = words[pos >> 5]                       # gather: [B, k] uint32
+    bit = (pos & 31).astype(np.uint32)
+    return np.all((probes >> bit) & np.uint32(1) == np.uint32(1), axis=1)
+
+
+# Byte -> set-bit-count table for the host-side popcount below (uint16:
+# sums over multi-MB filters must not wrap a uint8 accumulator lane).
+_POPCOUNT8 = np.array([bin(i).count("1") for i in range(256)],
+                      dtype=np.uint16)
+
+
+def bloom_packed_fill_fraction_np(words: np.ndarray) -> float:
+    """Host popcount twin of :func:`bloom_packed_fill_fraction` for
+    mirrored (numpy) filter words — the scrape/query paths read fill
+    from the epoch mirror instead of issuing a device reduction."""
+    words = np.asarray(words, dtype=np.uint32)
+    if words.size == 0:
+        return 0.0
+    set_bits = int(_POPCOUNT8[words.view(np.uint8)].sum(dtype=np.int64))
+    return set_bits / float(words.size * 32)
+
+
 def bloom_packed_fill_fraction(words: jax.Array) -> jax.Array:
     """Fraction of set bits of a packed filter (device scalar).
 

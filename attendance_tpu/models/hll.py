@@ -209,6 +209,35 @@ def hll_merge(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.maximum(a, b)
 
 
+def hll_histograms_np(rows: np.ndarray, precision: int = 14) -> np.ndarray:
+    """Register-value histograms for a stack of HOST register rows:
+    int64[num_rows, q+2] from uint8[num_rows, 2^p], in ONE bincount
+    pass (each row's values are offset into a disjoint bin range).
+
+    The query plane's batched PFCOUNT entry point: occupancy tables
+    over the epoch-pinned mirror histogram every requested bank in one
+    vectorized pass instead of a Python loop per lecture day."""
+    rows = np.atleast_2d(np.asarray(rows, dtype=np.uint8))
+    q = 64 - precision
+    bins = q + 2
+    n, m = rows.shape
+    offsets = (np.arange(n, dtype=np.int64) * bins)[:, None]
+    flat = np.bincount((rows.astype(np.int64) + offsets).ravel(),
+                       minlength=n * bins)
+    return flat.reshape(n, bins)
+
+
+def estimates_from_rows(rows: np.ndarray, precision: int = 14
+                        ) -> np.ndarray:
+    """Ertl estimates for a stack of host register rows: float64[n].
+    One vectorized histogram pass (``hll_histograms_np``), then the
+    scalar estimator per row — PFCOUNT is off the hot path, and the
+    per-row cost is ~q float ops."""
+    hists = hll_histograms_np(rows, precision)
+    return np.array([estimate_from_histogram(h, precision)
+                     for h in hists], dtype=np.float64)
+
+
 def _histogram_route(num_banks: int, backend: str) -> str:
     """Implementation choice for best_histogram, factored out so the
     routing (which only matters on device backends the hermetic CPU

@@ -302,6 +302,21 @@ class Telemetry:
         return render(self.registry)
 
 
+def _slo_specs_from(config) -> tuple:
+    """The config's SLO specs plus derived objectives: a set
+    ``read_staleness_ceiling_s`` IS a staleness SLO (one number, one
+    spelling). Derived here — at the point the specs are consumed —
+    so programmatic Config construction gets it exactly like the CLI
+    path (validate() is only called by config_from_args)."""
+    specs = list(getattr(config, "slo", ()) or ())
+    ceiling = getattr(config, "read_staleness_ceiling_s", 0.0)
+    if ceiling and not any(
+            s.replace(" ", "").startswith("read_staleness")
+            for s in specs):
+        specs.append(f"read_staleness<={ceiling}")
+    return tuple(specs)
+
+
 def enable(config) -> Telemetry:
     """Create, start, and install the global Telemetry from config."""
     global TELEMETRY
@@ -318,7 +333,7 @@ def enable(config) -> Telemetry:
             trace_out=getattr(config, "trace_out", ""),
             audit_sample=getattr(config, "audit_sample", 0.0),
             alert_log=getattr(config, "alert_log", ""),
-            slo_specs=tuple(getattr(config, "slo", ()) or ()),
+            slo_specs=_slo_specs_from(config),
             slo_fast_s=getattr(config, "slo_fast_s", 60.0),
             slo_slow_s=getattr(config, "slo_slow_s", 300.0))
         t.start()

@@ -333,6 +333,26 @@ class ShadowAuditor:
             return (np.fromiter(roster, np.uint32, len(roster)),
                     np.fromiter(negatives, np.uint32, len(negatives)))
 
+    def roster_membership(self, keys_u32: np.ndarray):
+        """(sampled_mask, member) against the fused roster shadow:
+        ``sampled_mask`` is bool[B] (which lanes the audit owns) and
+        ``member`` is bool[sampled] ground-truth roster membership of
+        those lanes — the read path's (serve/audit) classification
+        input. (None, None) once the roster shadow overflowed (no
+        ground truth, no measurement — same rule as the write path)."""
+        keys = np.asarray(keys_u32, np.uint32)
+        mask = self.sample_mask(keys)
+        if not mask.any():
+            return mask, np.zeros(0, dtype=bool)
+        sampled = keys[mask]
+        with self._lock:
+            if self._fused_dead():
+                return None, None
+            roster = self._bloom_shadow.get("__fused_roster__", set())
+            member = np.fromiter((int(k) in roster for k in sampled),
+                                 dtype=bool, count=len(sampled))
+        return mask, member
+
     def fused_day_truth(self) -> Dict[int, float]:
         """{lecture_day: exact shadow count scaled by 1/sample};
         empty once the roster shadow overflowed (valid-lane
@@ -371,6 +391,19 @@ def register_fused_audit(telemetry, pipe, **labels) -> None:
         return p
 
     def _query(p, keys: np.ndarray) -> np.ndarray:
+        # Prefer the epoch-pinned mirror: bit-identical to the device
+        # filter (run-static between preloads; every preload
+        # republishes) and immune to the scrape-vs-dispatch race on
+        # donated device arrays. Pipelines that never published an
+        # epoch keep the live device query.
+        mirror = getattr(p, "read_mirror", None)
+        epoch = mirror.pin() if mirror is not None else None
+        if epoch is not None and epoch.bloom_words is not None:
+            from attendance_tpu.models.bloom import (
+                bloom_contains_words_np)
+            return bloom_contains_words_np(
+                epoch.bloom_words, np.asarray(keys, np.uint32),
+                epoch.params)
         if p.sharded:
             return p.engine.contains(keys)
         from attendance_tpu.models.bloom import bloom_contains_words
@@ -405,6 +438,34 @@ def register_fused_audit(telemetry, pipe, **labels) -> None:
 
     def hll_rel_error() -> float:
         p = _deref()
+        # Under checkpointing, answer from the pinned epoch with the
+        # TRUTH SNAPSHOT captured at its publish: estimate and truth
+        # then describe the same moment (comparing a barrier-stale
+        # estimate against live-growing truth would charge barrier lag
+        # to the sketch), and the scrape never touches the device
+        # arrays a racing barrier capture is reading.
+        mirror = getattr(p, "read_mirror", None)
+        epoch = (mirror.pin() if mirror is not None
+                 and p.checkpointing else None)
+        if epoch is not None and epoch.day_truth is not None:
+            # day_truth == {} means the auditor existed but nothing
+            # was audited by this epoch's publish (e.g. the preload
+            # epoch): "no data yet" is NaN — falling back to a live
+            # device read here would reintroduce the scrape-vs-
+            # dispatch race this path exists to close.
+            from attendance_tpu.models.hll import estimates_from_rows
+            truth = epoch.day_truth
+            if not truth:
+                return float("nan")
+            days = [d for d in truth if d in epoch.bank_of]
+            if not days:
+                return float("nan")
+            banks = np.array([epoch.bank_of[d] for d in days],
+                             np.int64)
+            ests = estimates_from_rows(epoch.hll_regs[banks],
+                                       epoch.precision)
+            total_truth = sum(truth[d] for d in days)
+            return abs(float(ests.sum()) - total_truth) / total_truth
         truth = auditor.fused_day_truth()
         if not truth:
             return float("nan")

@@ -145,23 +145,56 @@ class FileReporter:
 
 class MetricsServer:
     """``GET /metrics`` over stdlib http.server; port 0 = ephemeral
-    (the bound port is exposed as ``.port``)."""
+    (the bound port is exposed as ``.port``).
+
+    Other subsystems may mount extra paths on the same endpoint via
+    :meth:`add_route` (the query plane's ``/query/*`` verbs,
+    serve/http.py): a route handler takes ``(method, path, query_str,
+    body_bytes)`` and returns ``(status, content_type, body_bytes)``.
+    Routes are matched by exact path after stripping the query string;
+    a raising handler answers 500 with the repr — never a hung
+    connection."""
 
     def __init__(self, registry: Registry, port: int,
                  host: str = "127.0.0.1"):
         outer = self
+        self.routes: dict = {}
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (stdlib naming)
-                if self.path not in ("/metrics", "/"):
-                    self.send_error(404)
+            def _dispatch(self, method: str):
+                path, _, query = self.path.partition("?")
+                route = outer.routes.get(path)
+                if route is not None:
+                    length = int(self.headers.get("Content-Length")
+                                 or 0)
+                    body = self.rfile.read(length) if length else b""
+                    try:
+                        status, ctype, reply = route(method, path,
+                                                     query, body)
+                    except Exception as exc:
+                        status, ctype = 500, "text/plain; charset=utf-8"
+                        reply = repr(exc).encode()
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(reply)))
+                    self.end_headers()
+                    self.wfile.write(reply)
                     return
-                body = render(outer.registry).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                if method == "GET" and path in ("/metrics", "/"):
+                    body = render(outer.registry).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_error(404)
+
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                self._dispatch("GET")
+
+            def do_POST(self):  # noqa: N802 (stdlib naming)
+                self._dispatch("POST")
 
             def log_message(self, *args):  # scrapes are not log lines
                 pass
@@ -172,6 +205,18 @@ class MetricsServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="metrics-http",
             daemon=True)
+
+    def add_route(self, path: str, handler) -> None:
+        """Mount ``handler(method, path, query, body) -> (status,
+        content_type, body_bytes)`` at an exact path."""
+        self.routes[path] = handler
+
+    def remove_route(self, path: str) -> None:
+        """Unmount a path (idempotent). Subsystems that mounted routes
+        must remove them on teardown: the server is process-global, so
+        a leaked closure would keep answering from (and pinning) a
+        dead owner's state."""
+        self.routes.pop(path, None)
 
     def start(self) -> "MetricsServer":
         self._thread.start()

@@ -81,8 +81,39 @@ def register_fused(telemetry, pipe, **labels) -> None:
         return
     ref = weakref.ref(pipe)
 
+    def _bloom_epoch(p):
+        """The pinned epoch, when its filter words can answer for the
+        live filter: the fused filter is run-static between preloads
+        (the hot loop never BF.ADDs) and every preload republishes, so
+        ANY epoch carrying words is bit-current — and reading it
+        avoids the scrape-vs-dispatch race on the donated device
+        arrays (a scrape racing a step used to observe a deleted
+        buffer and drop the sample)."""
+        mirror = getattr(p, "read_mirror", None)
+        epoch = mirror.pin() if mirror is not None else None
+        if epoch is not None and epoch.bloom_words is not None:
+            return epoch
+        return None
+
+    def _hll_epoch(p):
+        """The pinned epoch, when its register rows are the right
+        source for the HLL gauges: only under checkpointing, where
+        barriers republish at cadence — a scrape racing a barrier's
+        capture then reads a CONSISTENT epoch instead of torn bank
+        rows mid-gather. Without checkpointing nothing republishes
+        mid-run, so the live device read (pre-epoch behavior) stays."""
+        if not p.checkpointing:
+            return None
+        mirror = getattr(p, "read_mirror", None)
+        return mirror.pin() if mirror is not None else None
+
     def fill() -> float:
         p = _deref(ref)
+        epoch = _bloom_epoch(p)
+        if epoch is not None:
+            from attendance_tpu.models.bloom import (
+                bloom_packed_fill_fraction_np)
+            return bloom_packed_fill_fraction_np(epoch.bloom_words)
         if p.sharded:
             return float(p.engine.fill_fraction())
         from attendance_tpu.models.bloom import (
@@ -94,11 +125,26 @@ def register_fused(telemetry, pipe, **labels) -> None:
 
     def hll_estimate() -> float:
         p = _deref(ref)
+        epoch = _hll_epoch(p)
+        if epoch is not None:
+            from attendance_tpu.models.hll import estimates_from_rows
+            if not epoch.bank_of:
+                return 0.0
+            banks = np.fromiter(epoch.bank_of.values(), np.int64,
+                                len(epoch.bank_of))
+            ests = estimates_from_rows(epoch.hll_regs[banks],
+                                       epoch.precision)
+            # Per-bank integer rounding, matching count_all(): the
+            # gauge and the model's own method must agree exactly.
+            return float(np.rint(ests).sum())
         return float(sum(p.count_all().values()))
 
     def hll_saturated() -> float:
         p = _deref(ref)
         q = 64 - p.config.hll_precision
+        epoch = _hll_epoch(p)
+        if epoch is not None:
+            return float((epoch.hll_regs > q).sum())
         if p.sharded:
             # Max over the replica axis = the merged register view the
             # query path counts with (register-max union).

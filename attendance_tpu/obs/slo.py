@@ -129,6 +129,11 @@ def parse_slo(spec: str) -> Slo:
     if alias == "throughput":
         return Slo("throughput", "rate", "attendance_events_total",
                    op, threshold)
+    if alias == "read_staleness":
+        # The query plane's freshness objective: the published read
+        # epoch's age (bounded by the snapshot barrier cadence).
+        return Slo("read_staleness", "gauge",
+                   "attendance_read_staleness_seconds", op, threshold)
     if alias == "snapshot_failures":
         # The PR-robustness hook: a bounded-backoff writer retrying a
         # failing disk is healthy; an unbounded failure COUNT is not.
@@ -456,6 +461,10 @@ def _fmt_value(v: Optional[float]) -> str:
         return "n/a"
     if math.isnan(v):
         return "NaN"
+    if math.isinf(v):
+        # A quantile past the last finite bucket bound renders as the
+        # exposition spelling (int() on it would raise).
+        return "+Inf" if v > 0 else "-Inf"
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
     return f"{v:.6g}"
@@ -466,7 +475,9 @@ def _prom_checks(text: str, fpr_ceiling: float,
                  fire_burn: float,
                  snapshot_stall_ceiling: Optional[float],
                  max_reconnects: Optional[int] = None,
-                 lane_skew_ceiling: Optional[float] = None
+                 lane_skew_ceiling: Optional[float] = None,
+                 query_p99_ceiling: Optional[float] = None,
+                 staleness_ceiling: Optional[float] = None
                  ) -> List[List[str]]:
     from attendance_tpu.obs.exposition import parse_prom
 
@@ -543,6 +554,59 @@ def _prom_checks(text: str, fpr_ceiling: float,
                          f"<= {_fmt_value(snapshot_stall_ceiling)}",
                          "PASS" if p99 <= snapshot_stall_ceiling
                          else "FAIL"])
+    # Query plane: the read-path latency quantile (stage="query"
+    # histogram, same recovery as the snapshot stalls above), read-path
+    # accuracy (its own measured gauges, beside the write path's), and
+    # epoch staleness. Informational without ceilings; gates with them.
+    qpairs = []
+    for name, labels, value in samples:
+        if (name == "attendance_stage_latency_seconds_bucket"
+                and 'stage="query"' in labels):
+            le = _parse_le(labels)
+            if le is not None:
+                try:
+                    qpairs.append((le, float(value)))
+                except ValueError:
+                    continue
+    if qpairs and max(c for _, c in qpairs) > 0:
+        (p99,) = quantiles_from_cumulative(qpairs, (0.99,))
+        if query_p99_ceiling is None:
+            rows.append(["query p99", _fmt_value(p99), "-", "info"])
+        else:
+            rows.append(["query p99", _fmt_value(p99),
+                         f"<= {_fmt_value(query_p99_ceiling)}",
+                         "PASS" if p99 <= query_p99_ceiling
+                         else "FAIL"])
+    qfn = _vals("attendance_query_false_negatives_total")
+    if qfn:
+        worst = max(qfn)
+        rows.append(["query-path false negatives", _fmt_value(worst),
+                     "== 0", "PASS" if worst == 0 else "FAIL"])
+    qfpr = _vals("attendance_query_measured_fpr")
+    if qfpr:
+        rows.append(["query-path measured FPR",
+                     _fmt_value(max(qfpr)),
+                     f"<= {_fmt_value(fpr_ceiling)}",
+                     "PASS" if max(qfpr) <= fpr_ceiling else "FAIL"])
+    qerr = _vals("attendance_query_hll_rel_error")
+    if qerr:
+        rows.append(["query-path HLL rel error",
+                     _fmt_value(max(qerr)),
+                     f"<= {_fmt_value(hll_error_ceiling)}",
+                     "PASS" if max(qerr) <= hll_error_ceiling
+                     else "FAIL"])
+    stale = _vals("attendance_read_staleness_seconds")
+    if stale or staleness_ceiling is not None:
+        worst = max(stale) if stale else None
+        if staleness_ceiling is None:
+            rows.append(["read epoch staleness",
+                         _fmt_value(worst), "-", "info"])
+        else:
+            rows.append(["read epoch staleness", _fmt_value(worst),
+                         f"<= {_fmt_value(staleness_ceiling)}",
+                         "n/a" if worst is None
+                         else ("PASS" if worst <= staleness_ceiling
+                               else "FAIL")])
     chain = _vals("attendance_snapshot_chain_length")
     if chain:
         rows.append(["snapshot chain length", _fmt_value(max(chain)),
@@ -664,6 +728,8 @@ def doctor_report(paths: Sequence[str], *,
                   snapshot_stall_ceiling: Optional[float] = None,
                   max_reconnects: Optional[int] = None,
                   lane_skew_ceiling: Optional[float] = None,
+                  query_p99_ceiling: Optional[float] = None,
+                  staleness_ceiling: Optional[float] = None,
                   quarantine_dir: str = ""
                   ) -> Tuple[str, bool]:
     """Replay run artifacts offline; returns (verdict text, ok).
@@ -691,7 +757,9 @@ def doctor_report(paths: Sequence[str], *,
                                      hll_error_ceiling, fire_burn,
                                      snapshot_stall_ceiling,
                                      max_reconnects,
-                                     lane_skew_ceiling))
+                                     lane_skew_ceiling,
+                                     query_p99_ceiling,
+                                     staleness_ceiling))
         elif kind == "alerts":
             arows, traces = _alert_checks(payload)
             rows.extend(arows)

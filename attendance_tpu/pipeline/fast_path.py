@@ -90,6 +90,109 @@ CHAIN_MANIFEST = "CHAIN.json"  # fsync'd base+delta chain manifest
 _SNAP_QUEUE_DEPTH = 2  # staged delta captures in flight (double buffer)
 
 
+def read_chain_state(snap_dir, *, expect_m_bits: Optional[int] = None,
+                     expect_precision: Optional[int] = None) -> dict:
+    """Merge-on-read over a snapshot directory: the base npz plus every
+    CHAIN.json-listed delta, applied in order. Shared by
+    :meth:`FusedPipeline.restore` and the query plane's separate-process
+    chain readers (serve/chain) — one loader, one crash contract.
+
+    Every observable state is self-consistent under the chain's write
+    protocol (delta files are fsync'd before the manifest names them;
+    a full base resets the manifest BEFORE deleting superseded deltas),
+    so a reader racing the writer sees either the old chain or the new
+    one. The one benign race — a named delta deleted by compaction
+    between our manifest read and file open — surfaces as the
+    ValueError below, which chain readers handle by re-reading the
+    manifest and retrying.
+
+    Raises FileNotFoundError when no base snapshot exists."""
+    snap_dir = Path(snap_dir)
+    path = snap_dir / SKETCH_SNAPSHOT
+    if not path.exists():
+        raise FileNotFoundError(f"no base snapshot at {path}")
+    chain: list = []
+    chain_path = snap_dir / CHAIN_MANIFEST
+    if chain_path.exists():
+        chain = list(json.loads(
+            chain_path.read_text()).get("deltas", []))
+    with np.load(path) as data:
+        manifest = json.loads(bytes(data["manifest"]).decode())
+        if (expect_m_bits is not None
+                and manifest["m_bits"] != expect_m_bits):
+            raise ValueError(
+                f"snapshot filter is {manifest['m_bits']} bits but "
+                f"config derives {expect_m_bits} — capacity/"
+                "error-rate/layout changed since the snapshot")
+        if (expect_precision is not None
+                and manifest["precision"] != expect_precision):
+            raise ValueError(
+                f"snapshot HLL precision is {manifest['precision']} "
+                f"but config requests {expect_precision} — "
+                "register banks are not convertible across precisions")
+        bits = np.array(data["bloom_words"])
+        regs = np.array(data["hll_regs"], dtype=np.uint8)
+        counts = np.array(data["counts"] if "counts" in data
+                          else np.zeros((2, 2), np.uint32))
+    bank_of_raw = manifest["bank_of"]
+    events = manifest["events"]
+    # Staleness fence (see _write_snapshot_files): a crash between
+    # a full base's in-place replace and the chain-manifest reset
+    # leaves the old delta list naming files OLDER than the base —
+    # every legit delta's sequence number exceeds the chain_seq
+    # its base recorded. Applying a stale one would regress
+    # registers and shear bank_of off the register banks. Bases
+    # from before this field never coexist with a chain manifest.
+    base_seq = int(manifest.get("chain_seq", -1))
+    applied: list = []
+    for name in chain:
+        dpath = snap_dir / name
+        if not dpath.exists():
+            raise ValueError(
+                f"chain manifest names {name} but the delta file "
+                "is missing — snapshot directory is corrupt")
+        if int(name.split("-")[1].split(".")[0]) <= base_seq:
+            continue  # stale: older than the restored base
+        with np.load(dpath) as d:
+            dman = json.loads(bytes(d["manifest"]).decode())
+            nb = int(dman.get("num_banks", regs.shape[0]))
+            if nb > regs.shape[0]:
+                grown = np.zeros((nb, regs.shape[1]), np.uint8)
+                grown[:regs.shape[0]] = regs
+                regs = grown
+            idx = np.asarray(d["bank_idx"], np.int64)
+            if len(idx):
+                if int(idx.max()) >= regs.shape[0]:
+                    raise ValueError(
+                        f"delta {name} writes bank {int(idx.max())}"
+                        f" but the chain only restored "
+                        f"{regs.shape[0]} banks — chain is corrupt")
+                regs[idx] = d["regs_rows"]
+            counts = np.array(d["counts"], np.uint32)
+            bank_of_raw = dman["bank_of"]
+            events = dman["events"]
+        applied.append(name)
+    # The bank map must be consistent with the register banks it
+    # routes into — a stale/hand-edited manifest that references
+    # banks beyond the restored array would silently misroute
+    # every PFADD for those days. Fail loudly instead.
+    bank_vals = [int(b) for b in bank_of_raw.values()]
+    if bank_vals:
+        if len(set(bank_vals)) != len(bank_vals):
+            raise ValueError(
+                "snapshot manifest maps two days to one HLL bank"
+                " — manifest is corrupt")
+        if max(bank_vals) >= regs.shape[0]:
+            raise ValueError(
+                f"snapshot manifest references bank "
+                f"{max(bank_vals)} but only {regs.shape[0]} "
+                "register banks were restored — manifest and "
+                "registers are from different snapshots")
+    return dict(bits=bits, regs=regs, counts=counts,
+                bank_of=bank_of_raw, events=events, applied=applied,
+                manifest=manifest)
+
+
 class _ScatterValidity:
     """Lazy original-order view of the seg/delta wires' permuted
     validity.
@@ -328,6 +431,20 @@ class FusedPipeline:
             self._g_chain_len = self._obs.registry.gauge(
                 "attendance_snapshot_chain_length",
                 help="Delta files since the last full base snapshot")
+        # Epoch-pinned read mirror (serve/): the snapshot plane's host
+        # register state published as immutable epochs — the query
+        # plane and the scrape-time health/audit gauges read from a
+        # pinned epoch instead of racing the hot loop's donated device
+        # arrays. Publication rides the paths that already hold host
+        # copies (preload, restore, snapshot barriers), so the hot
+        # loop itself never pays for it.
+        from attendance_tpu.serve.mirror import ReadMirror
+        self.read_mirror = ReadMirror()
+        self._roster_size = 0
+        self.query_server = None
+        self.query_engine = None
+        if self._obs is not None:
+            self.read_mirror.register_gauges(self._obs)
         if self._snap_dir is not None:
             self.restore()
         # Accuracy auditor (obs/audit.py): the hot loop only RECORDS
@@ -346,6 +463,29 @@ class FusedPipeline:
             if self._auditor is not None:
                 from attendance_tpu.obs.audit import register_fused_audit
                 register_fused_audit(self._obs, self)
+        serve_port = getattr(self.config, "serve_port", 0)
+        if serve_port:
+            # In-process query plane (serve/): a vectorized executor
+            # over the read mirror behind a binary batch RPC port,
+            # plus JSON routes on the live /metrics endpoint. Queries
+            # never touch the device or the hot loop — they answer
+            # from whatever epoch the barriers last published.
+            from attendance_tpu.serve.engine import QueryEngine
+            from attendance_tpu.serve.rpc import QueryServer
+            ceiling = getattr(self.config,
+                              "read_staleness_ceiling_s", 0.0)
+            self.query_engine = QueryEngine(
+                self.read_mirror, obs=self._obs,
+                batch_max=getattr(self.config, "query_batch_max",
+                                  1 << 16),
+                staleness_ceiling_s=ceiling or None)
+            self.query_server = QueryServer(
+                self.query_engine,
+                port=0 if serve_port < 0 else serve_port).start()
+            if (self._obs is not None
+                    and getattr(self._obs, "_server", None) is not None):
+                from attendance_tpu.serve import http as serve_http
+                serve_http.attach(self._obs._server, self.query_engine)
 
     _LUT_SIZE = 1 << 14  # covers ~44 years of calendar days from base
     _TRACE_ROLE = "fused-pipeline"
@@ -373,6 +513,23 @@ class FusedPipeline:
             # the filter does not hold yet reads the whole roster as
             # false negatives (seen under chaos-soak timing).
             self._auditor.record_roster(keys)
+        self._roster_size = len(keys)
+        if not self.sharded and (self.checkpointing
+                                 or self.query_engine is not None):
+            # Seed the first read epoch (and the snapshot path's host
+            # filter cache) from the freshly preloaded state. Gated:
+            # plain ingest runs must not pay a D2H here — on the
+            # relay-tunneled platform one read of the donated-chain
+            # state flips the process into a degraded dispatch mode
+            # (see run()'s D2H note), so only runs that will read
+            # host-side anyway (barriers, queries) take it, pre-run
+            # where it is cheapest. The sharded engine publishes its
+            # first epoch at the first barrier instead (its state
+            # gather contains collectives).
+            self._bloom_host = np.asarray(self.state.bloom_bits)
+            self._publish_epoch(np.asarray(self.state.hll_regs),
+                                np.asarray(self.state.counts),
+                                bank_of=dict(self._bank_of))
 
     # -- bank mapping -------------------------------------------------------
     def _num_banks(self) -> int:
@@ -1112,6 +1269,8 @@ class FusedPipeline:
         # write failure on process 0 crashes the lockstep anyway).
         self._dirty_days.clear()
         self._regs_mirror = np.array(regs, dtype=np.uint8, copy=True)
+        self._publish_epoch(self._regs_mirror, counts,
+                            bank_of=dict(self._bank_of))
         if jax.process_count() > 1 and jax.process_index() != 0:
             # Multi-controller lockstep (DCN cluster): every process
             # holds the same replicated state, so exactly one writes
@@ -1308,6 +1467,11 @@ class FusedPipeline:
         gauges, and fold the chain into a fresh base when it reached
         the compaction cadence."""
         self._apply_mirror_rows(banks, rows, num_banks)
+        if self._regs_mirror is not None:
+            # The mirror now reflects this delta: publish it as the
+            # next read epoch (the atomic swap readers pin against).
+            self._publish_epoch(self._regs_mirror, counts,
+                                bank_of=bank_of, events=events)
         if self._g_delta_bytes is not None:
             self._g_delta_bytes.set(float(nbytes))
             self._g_chain_len.set(float(len(self._snap_chain)))
@@ -1447,6 +1611,9 @@ class FusedPipeline:
                     job["events"], job["upto"])
             self._regs_mirror = np.array(regs_h, dtype=np.uint8,
                                          copy=True)
+            self._publish_epoch(self._regs_mirror, counts_h,
+                                bank_of=job["bank_of"],
+                                events=job["events"])
             self._writer_base_ok = True
             if self._g_chain_len is not None:
                 self._g_chain_len.set(0.0)
@@ -1466,6 +1633,48 @@ class FusedPipeline:
         self._post_delta_bookkeeping(banks, rows_h, nbytes, counts_h,
                                      job["bank_of"], job["events"],
                                      job["num_banks"])
+
+    def _publish_epoch(self, regs_h: np.ndarray, counts_h,
+                       *, bank_of: dict,
+                       events: Optional[int] = None) -> None:
+        """Publish one read epoch from host-side register state (cold
+        paths and the snapshot writer only — never the hot loop). The
+        shadow's per-day truth is snapshotted WITH the epoch so the
+        read-path HLL audit compares estimate and truth from the same
+        moment instead of charging barrier staleness to the sketch."""
+        auditor = getattr(self, "_auditor", None)
+        day_truth = (auditor.fused_day_truth()
+                     if auditor is not None else None)
+        self.read_mirror.publish(
+            regs=regs_h,
+            events=(self.metrics.events if events is None else events),
+            bank_of=bank_of, params=self.params,
+            precision=self.config.hll_precision,
+            bloom_words=self._bloom_host,
+            counts=np.asarray(counts_h) if counts_h is not None
+            else None,
+            roster_size=self._roster_size, day_truth=day_truth)
+
+    def publish_epoch(self) -> None:
+        """Force one synchronous epoch publish from the CURRENT device
+        state — for embedders/benches that serve queries without
+        checkpointing (snapshot barriers are the normal publisher).
+        Performs device reads: call from cold paths (setup, between
+        runs), never mid-stream on relay-tunneled devices (see
+        run()'s D2H note)."""
+        self._flush_snapshots()
+        if self.sharded:
+            bits, regs = self.engine.get_state()
+            counts = self.engine.get_counts()
+            self._bloom_host = np.asarray(bits)
+            regs_h = np.asarray(regs, dtype=np.uint8)
+        else:
+            if self._bloom_host is None:
+                self._bloom_host = np.asarray(self.state.bloom_bits)
+            regs_h = np.asarray(self.state.hll_regs)
+            counts = np.asarray(self.state.counts)
+        self._publish_epoch(regs_h, counts,
+                            bank_of=dict(self._bank_of))
 
     def _apply_mirror_rows(self, banks, rows: np.ndarray,
                            num_banks: int) -> None:
@@ -1574,92 +1783,26 @@ class FusedPipeline:
     def restore(self) -> bool:
         """Load the latest snapshot from snapshot_dir, if one exists:
         the base npz plus — when a CHAIN.json manifest is present —
-        every delta it names, applied in order (dirty-bank register
-        rows, counter totals, and the bank map / event count of the
-        last delta win). Delta files on disk that the manifest does
-        NOT name are crash orphans (written but never made durable by
-        a manifest rename) and are ignored; their frames were never
-        acked and redeliver."""
+        every delta it names, applied in order (via the shared
+        :func:`read_chain_state` merge-on-read loader the query
+        plane's chain readers also use). Delta files on disk that the
+        manifest does NOT name are crash orphans (written but never
+        made durable by a manifest rename) and are ignored; their
+        frames were never acked and redeliver."""
         if self._snap_dir is None:
             return False
-        path = self._snap_dir / SKETCH_SNAPSHOT
-        if not path.exists():
+        try:
+            chain_state = read_chain_state(
+                self._snap_dir, expect_m_bits=self.params.m_bits,
+                expect_precision=self.config.hll_precision)
+        except FileNotFoundError:
             return False
-        chain: list = []
-        chain_path = self._snap_dir / CHAIN_MANIFEST
-        if chain_path.exists():
-            chain = list(json.loads(
-                chain_path.read_text()).get("deltas", []))
-        with np.load(path) as data:
-            manifest = json.loads(bytes(data["manifest"]).decode())
-            if manifest["m_bits"] != self.params.m_bits:
-                raise ValueError(
-                    f"snapshot filter is {manifest['m_bits']} bits but "
-                    f"config derives {self.params.m_bits} — capacity/"
-                    "error-rate/layout changed since the snapshot")
-            if manifest["precision"] != self.config.hll_precision:
-                raise ValueError(
-                    f"snapshot HLL precision is {manifest['precision']} "
-                    f"but config requests {self.config.hll_precision} — "
-                    "register banks are not convertible across precisions")
-            bits = data["bloom_words"]
-            regs = np.array(data["hll_regs"], dtype=np.uint8)
-            counts = (data["counts"] if "counts" in data
-                      else np.zeros((2, 2), np.uint32))
-        bank_of_raw = manifest["bank_of"]
-        events = manifest["events"]
-        # Staleness fence (see _write_snapshot_files): a crash between
-        # a full base's in-place replace and the chain-manifest reset
-        # leaves the old delta list naming files OLDER than the base —
-        # every legit delta's sequence number exceeds the chain_seq
-        # its base recorded. Applying a stale one would regress
-        # registers and shear bank_of off the register banks. Bases
-        # from before this field never coexist with a chain manifest.
-        base_seq = int(manifest.get("chain_seq", -1))
-        applied: list = []
-        for name in chain:
-            dpath = self._snap_dir / name
-            if not dpath.exists():
-                raise ValueError(
-                    f"chain manifest names {name} but the delta file "
-                    "is missing — snapshot directory is corrupt")
-            if int(name.split("-")[1].split(".")[0]) <= base_seq:
-                continue  # stale: older than the restored base
-            with np.load(dpath) as d:
-                dman = json.loads(bytes(d["manifest"]).decode())
-                nb = int(dman.get("num_banks", regs.shape[0]))
-                if nb > regs.shape[0]:
-                    grown = np.zeros((nb, regs.shape[1]), np.uint8)
-                    grown[:regs.shape[0]] = regs
-                    regs = grown
-                idx = np.asarray(d["bank_idx"], np.int64)
-                if len(idx):
-                    if int(idx.max()) >= regs.shape[0]:
-                        raise ValueError(
-                            f"delta {name} writes bank {int(idx.max())}"
-                            f" but the chain only restored "
-                            f"{regs.shape[0]} banks — chain is corrupt")
-                    regs[idx] = d["regs_rows"]
-                counts = np.array(d["counts"], np.uint32)
-                bank_of_raw = dman["bank_of"]
-                events = dman["events"]
-            applied.append(name)
-        # The bank map must be consistent with the register banks it
-        # routes into — a stale/hand-edited manifest that references
-        # banks beyond the restored array would silently misroute
-        # every PFADD for those days. Fail loudly instead.
-        bank_vals = [int(b) for b in bank_of_raw.values()]
-        if bank_vals:
-            if len(set(bank_vals)) != len(bank_vals):
-                raise ValueError(
-                    "snapshot manifest maps two days to one HLL bank"
-                    " — manifest is corrupt")
-            if max(bank_vals) >= regs.shape[0]:
-                raise ValueError(
-                    f"snapshot manifest references bank "
-                    f"{max(bank_vals)} but only {regs.shape[0]} "
-                    "register banks were restored — manifest and "
-                    "registers are from different snapshots")
+        bits = chain_state["bits"]
+        regs = chain_state["regs"]
+        counts = chain_state["counts"]
+        bank_of_raw = chain_state["bank_of"]
+        events = chain_state["events"]
+        applied = chain_state["applied"]
         if self.sharded:
             self.engine.set_state(bits, regs)
             self.engine.set_counts(counts)
@@ -1693,6 +1836,8 @@ class FusedPipeline:
         self._snap_chain = applied
         self._dirty_days.clear()
         self._regs_mirror = np.array(regs, dtype=np.uint8, copy=True)
+        self._publish_epoch(self._regs_mirror, counts,
+                            bank_of=self._bank_of, events=events)
         self._base_stale = False
         self._writer_base_ok = True
         self._delta_seq = max(
@@ -2034,6 +2179,12 @@ class FusedPipeline:
         # transport it would ack through (the write itself is already
         # durable either way; this just keeps the acks clean), then
         # shut the writer thread down.
+        if self.query_server is not None:
+            self.query_server.stop()
+            if (self._obs is not None
+                    and getattr(self._obs, "_server", None) is not None):
+                from attendance_tpu.serve import http as serve_http
+                serve_http.detach(self._obs._server)
         self._flush_snapshots()
         self._stop_snap_writer()
         if hasattr(self.consumer, "lanes"):

@@ -1,0 +1,47 @@
+"""Live query-serving plane: reads off an epoch-pinned host mirror.
+
+The write engine (pipeline/fast_path) holds every answer the paper's
+offline analytics layer computes — Bloom membership, per-lecture HLL
+cardinalities, validity counters — but until this package the system
+was write-only: queries either touched the device hot loop (forbidden:
+one stray D2H collapses async dispatch on tunneled devices) or waited
+for offline artifact replay.
+
+The serving model, bottom to top:
+
+* :mod:`serve.mirror` — an **epoch-pinned read view** of the sketch
+  state. The snapshot plane's host register mirror and the run-static
+  host Bloom words are published as immutable :class:`Epoch` objects;
+  publication is one atomic reference swap (readers pin an epoch by
+  holding it — no locks, no reader/writer coordination, and the hot
+  loop pays nothing). Register buffers are double-buffered: a buffer
+  is recycled only when no reader still pins its epoch.
+* :mod:`serve.engine` — a **vectorized executor** answering whole
+  request batches from a pinned epoch: BF.EXISTS via the numpy twin of
+  the packed-word probe (``models.bloom.bloom_contains_words_np``),
+  PFCOUNT/occupancy via one batched histogram pass over mirrored HLL
+  rows (``models.hll.estimates_from_rows``). Per-query Python cost is
+  amortized across the batch — the >=1M point-queries/s path.
+* :mod:`serve.rpc` — a **length-prefixed binary batch RPC** on the
+  socket broker's framing, with the PR 5 retry/reconnect/chaos seams
+  on the client side (site ``serve.query``).
+* :mod:`serve.http` — the same verbs as JSON routes behind the
+  existing ``--metrics-port`` HTTP endpoint.
+* :mod:`serve.chain` — **merge-on-read** over the on-disk base+delta
+  snapshot chain, so a separate reader process serves queries without
+  joining the ingest process at all (item 4's read replicas).
+* :mod:`serve.audit` — sampled read answers cross-checked against the
+  exact shadow (obs/audit), exporting measured-FPR / zero-FN /
+  HLL-error gauges for the READ path beside the write path's.
+
+Epoch/staleness semantics: an epoch is published at every snapshot
+barrier (plus preload/restore and explicit ``publish_epoch`` calls),
+so read staleness is bounded by the barrier cadence; the
+``attendance_read_staleness_seconds`` gauge exposes the current
+epoch's age and ``--read-staleness-ceiling-s`` turns it into an SLO.
+Queries always answer from a CONSISTENT epoch — stale by at most one
+barrier interval, never torn.
+"""
+
+from attendance_tpu.serve.mirror import Epoch, ReadMirror  # noqa: F401
+from attendance_tpu.serve.engine import QueryEngine  # noqa: F401

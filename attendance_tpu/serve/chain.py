@@ -1,0 +1,175 @@
+"""Merge-on-read epoch source over the on-disk snapshot chain.
+
+A separate READER process serves queries without ever joining the
+ingest process: it opens the snapshot directory, loads the base
+snapshot plus every manifest-listed delta (the same
+``fast_path.read_chain_state`` restore uses), and publishes the merged
+state as an epoch. A background thread re-reads the chain manifest at
+``refresh_s`` cadence and republishes when the writer published new
+durable state — read staleness is then (barrier cadence + refresh
+cadence), and the ``attendance_read_staleness_seconds`` gauge reports
+it honestly via the epoch's manifest mtime.
+
+Concurrent manifest swap (the ingest writer compacting or appending
+WHILE this reader loads) is handled by retry: the chain contract makes
+every manifest state self-consistent (a delta is named only after its
+fsync'd file exists; compaction resets the manifest BEFORE deleting
+superseded deltas), so the only possible race is a named file
+vanishing under compaction between our manifest read and file open —
+the loader then re-reads the manifest and tries again. A reader
+therefore serves either the old epoch or the new one, never a mix.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from attendance_tpu.serve.mirror import Epoch
+
+logger = logging.getLogger(__name__)
+
+_SWAP_RETRIES = 8
+
+
+class ChainEpochSource:
+    """``pin()``-compatible epoch source over a snapshot directory."""
+
+    def __init__(self, snapshot_dir, *, refresh_s: float = 1.0,
+                 obs=None):
+        from attendance_tpu.pipeline.fast_path import CHAIN_MANIFEST
+
+        self._dir = Path(snapshot_dir)
+        self._manifest = self._dir / CHAIN_MANIFEST
+        self.refresh_s = refresh_s
+        self._epoch: Optional[Epoch] = None
+        self._fingerprint = None
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.reload()  # fail fast on an unreadable/absent chain
+        if obs is not None:
+            from attendance_tpu.serve.mirror import (
+                register_staleness_gauges)
+            register_staleness_gauges(obs, self)
+
+    # -- epoch-source surface ------------------------------------------------
+    def pin(self) -> Optional[Epoch]:
+        return self._epoch
+
+    def staleness_s(self) -> float:
+        e = self._epoch
+        return float("nan") if e is None else e.age_s()
+
+    # -- loading -------------------------------------------------------------
+    def _chain_fingerprint(self):
+        """(manifest bytes, base mtime_ns) — changes iff a publish or
+        compaction landed. The manifest CONTENT (not mtime) is the
+        primary key: an in-place base refold keeps the delta list
+        empty but bumps the base file."""
+        from attendance_tpu.pipeline.fast_path import SKETCH_SNAPSHOT
+
+        try:
+            manifest = self._manifest.read_bytes()
+        except FileNotFoundError:
+            manifest = b""
+        try:
+            base_mtime = (self._dir / SKETCH_SNAPSHOT).stat().st_mtime_ns
+        except FileNotFoundError:
+            base_mtime = 0
+        return manifest, base_mtime
+
+    def reload(self, force: bool = False) -> bool:
+        """Load the chain if it changed since the last load; returns
+        True when a new epoch was published. Retries across concurrent
+        manifest swaps (see module docstring)."""
+        from attendance_tpu.pipeline.fast_path import read_chain_state
+
+        fp = self._chain_fingerprint()
+        if not force and fp == self._fingerprint and \
+                self._epoch is not None:
+            return False
+        last_exc: Optional[Exception] = None
+        for _attempt in range(_SWAP_RETRIES):
+            try:
+                state = read_chain_state(self._dir)
+            except FileNotFoundError:
+                raise
+            except (ValueError, OSError) as exc:
+                # A named delta vanished (compaction won the race) or
+                # the manifest itself is mid-swap: re-read and retry.
+                last_exc = exc
+                time.sleep(0.01)
+                continue
+            # Record the fingerprint captured BEFORE the load: if a
+            # publish landed mid-load we may have read the older
+            # state, and a stale recorded fingerprint makes the next
+            # refresh notice and reload — recording the post-load
+            # fingerprint instead would mask that final publish
+            # forever (the reader would serve the second-to-last
+            # epoch until some later publish happened).
+            self._fingerprint = fp
+            self._seq += 1
+            from attendance_tpu.models.bloom import BloomParams
+            man = state["manifest"]
+            params = BloomParams(
+                m_bits=int(man["m_bits"]), k=int(man["k"]),
+                layout="blocked", capacity=0, error_rate=0.0)
+            self._epoch = Epoch(
+                seq=self._seq, events=int(state["events"]),
+                bloom_words=np.asarray(state["bits"], np.uint32),
+                hll_regs=np.asarray(state["regs"], np.uint8),
+                counts=np.asarray(state["counts"], np.uint32),
+                bank_of=dict(state["bank_of"]), params=params,
+                precision=int(man["precision"]), source="chain",
+                # Staleness must describe the DATA, not this reader's
+                # load time: an hour-old chain served by a
+                # just-started reader is an hour stale, and a reader
+                # restart must not reset the freshness gauge/SLO.
+                published_at=self._chain_mtime())
+            return True
+        raise RuntimeError(
+            f"chain at {self._dir} kept moving for {_SWAP_RETRIES} "
+            f"read attempts: {last_exc!r}")
+
+    def _chain_mtime(self) -> float:
+        """Publication time of the on-disk state: the newest of the
+        chain manifest and the base file (compaction refolds the base
+        without touching the manifest content)."""
+        from attendance_tpu.pipeline.fast_path import SKETCH_SNAPSHOT
+
+        newest = 0.0
+        for path in (self._manifest, self._dir / SKETCH_SNAPSHOT):
+            try:
+                newest = max(newest, path.stat().st_mtime)
+            except FileNotFoundError:
+                continue
+        return newest or time.time()
+
+    # -- refresh thread ------------------------------------------------------
+    def start(self) -> "ChainEpochSource":
+        self._thread = threading.Thread(
+            target=self._loop, name="chain-refresh", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.refresh_s):
+            try:
+                if self.reload():
+                    logger.info(
+                        "chain reader refreshed: epoch %d, %d events",
+                        self._epoch.seq, self._epoch.events)
+            except Exception:
+                logger.exception("chain refresh failed (will retry)")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

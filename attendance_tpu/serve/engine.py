@@ -1,0 +1,269 @@
+"""Vectorized query executor over a pinned epoch.
+
+One :class:`QueryEngine` answers whole request batches from whatever
+epoch source it was built over — the live pipeline's
+:class:`serve.mirror.ReadMirror` or a :class:`serve.chain`
+merge-on-read chain source. Every verb pins ONE epoch up front and
+answers the entire batch from it (snapshot isolation: a barrier
+publishing mid-batch changes nothing the batch sees).
+
+Verbs and their vectorized cores:
+
+* ``bf_exists(keys)`` — BF.EXISTS over a u32 key batch: the numpy twin
+  of the packed-word probe (``bloom_contains_words_np``), ~k gathers
+  over the whole batch. This is the >=1M point-queries/s path.
+* ``pfcount(days)`` — per-lecture-day HLL estimates: requested days
+  resolve to bank rows through the epoch's bank map, ONE batched
+  histogram pass (``estimates_from_rows``) covers every distinct bank.
+* ``occupancy()`` — the full {day: unique} table (every registered
+  bank, one pass) — the paper's per-lecture occupancy question.
+* ``attendance_rate(roster_size)`` — occupancy / roster, the paper's
+  attendance-rate table (roster defaults to the epoch's preload size).
+* ``stats()`` — epoch metadata: seq, age, events, validity counters.
+
+Observability: per-verb request/key counters, batch-size and epoch-age
+histograms, a ``query`` stage-latency histogram (which makes
+``--slo query_p99<=...`` work through the existing burn-rate engine
+unchanged), query spans in the live trace, and sampled answers
+cross-checked against the exact shadow (serve/audit).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from attendance_tpu.models.bloom import bloom_contains_words_np
+from attendance_tpu.models.hll import estimates_from_rows
+from attendance_tpu.serve.mirror import Epoch
+
+
+class NoEpoch(RuntimeError):
+    """No epoch has been published yet (nothing to answer from)."""
+
+
+class QueryEngine:
+    _TRACE_ROLE = "query-engine"
+
+    def __init__(self, source, *, obs=None, batch_max: int = 1 << 16,
+                 staleness_ceiling_s: Optional[float] = None):
+        """``source`` is anything with ``pin() -> Epoch | None``."""
+        self._source = source
+        self.batch_max = max(1, batch_max)
+        self.staleness_ceiling_s = staleness_ceiling_s
+        self._obs = obs
+        self._tracer = obs.tracer if obs is not None else None
+        self._auditor = None
+        self._h_latency = None
+        self._counters: Dict[str, object] = {}
+        self._key_counters: Dict[str, object] = {}
+        self._h_batch: Dict[str, object] = {}
+        if obs is not None:
+            # Latency rides the shared stage histogram, so the SLO
+            # engine's `<stage>_p<NN>` specs (query_p99<=...) and the
+            # doctor's quantile recovery work with no new machinery.
+            self._h_latency = obs.stage("query")
+            self._h_epoch_age = obs.registry.histogram(
+                "attendance_query_epoch_age_seconds",
+                help="Age of the epoch each query batch was answered "
+                "from", scale=1e3)
+            if obs.auditor is not None:
+                from attendance_tpu.serve.audit import ReadAuditor
+                self._auditor = ReadAuditor(obs.registry, obs.auditor)
+
+    # -- epoch access --------------------------------------------------------
+    def pin(self) -> Epoch:
+        epoch = self._source.pin()
+        if epoch is None:
+            raise NoEpoch("no epoch published yet — preload/restore "
+                          "or a snapshot barrier publishes the first")
+        return epoch
+
+    def staleness_s(self) -> float:
+        epoch = self._source.pin()
+        return float("nan") if epoch is None else epoch.age_s()
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _note(self, verb: str, n: int, epoch: Epoch, t0: float) -> None:
+        obs = self._obs
+        if obs is None:
+            return
+        t1 = time.perf_counter()
+        c = self._counters.get(verb)
+        if c is None:
+            c = self._counters[verb] = obs.registry.counter(
+                "attendance_query_requests_total",
+                help="Query batches answered, per verb", verb=verb)
+            self._key_counters[verb] = obs.registry.counter(
+                "attendance_query_keys_total",
+                help="Point answers produced (keys/days per batch "
+                "summed), per verb", verb=verb)
+            self._h_batch[verb] = obs.registry.histogram(
+                "attendance_query_batch_size",
+                help="Keys/days per query batch", scale=1.0,
+                verb=verb)
+        c.inc()
+        self._key_counters[verb].inc(max(n, 1))
+        self._h_batch[verb].observe(float(max(n, 1)))
+        self._h_latency.observe(t1 - t0)
+        self._h_epoch_age.observe(max(epoch.age_s(), 0.0))
+        tr = self._tracer
+        if tr is not None:
+            cur = tr.current()
+            tr.add_span(
+                "query", t0, t1,
+                trace_id=cur.trace_id if cur is not None else tr.new_id(),
+                parent_id=cur.span_id if cur is not None else None,
+                role=self._TRACE_ROLE,
+                args={"verb": verb, "n": n, "epoch": epoch.seq})
+
+    def _check_batch(self, n: int) -> None:
+        if n > self.batch_max:
+            raise ValueError(
+                f"query batch of {n} exceeds --query-batch-max "
+                f"{self.batch_max}")
+
+    # -- verbs ---------------------------------------------------------------
+    def bf_exists(self, keys) -> np.ndarray:
+        """BF.EXISTS for a batch of u32 keys: bool[B] from the pinned
+        epoch's packed filter words — no device, no locks."""
+        t0 = time.perf_counter()
+        keys = np.ascontiguousarray(keys, dtype=np.uint32)
+        self._check_batch(len(keys))
+        epoch = self.pin()
+        if epoch.bloom_words is None:
+            raise NoEpoch("epoch carries no filter words (no preload "
+                          "reached the mirror yet)")
+        out = bloom_contains_words_np(epoch.bloom_words, keys,
+                                      epoch.params)
+        if self._auditor is not None:
+            self._auditor.check_bf(keys, out)
+        self._note("exists", len(keys), epoch, t0)
+        return out
+
+    def _estimates(self, epoch: Epoch, days: np.ndarray) -> np.ndarray:
+        """Estimates for a day vector: distinct known banks histogram
+        in ONE pass; unknown days answer 0 (Redis PFCOUNT of a missing
+        key)."""
+        bank_of = epoch.bank_of
+        banks = np.array([bank_of.get(int(d), -1) for d in days],
+                         dtype=np.int64)
+        known = np.unique(banks[banks >= 0])
+        out = np.zeros(len(days), dtype=np.int64)
+        if len(known):
+            ests = estimates_from_rows(epoch.hll_regs[known],
+                                       epoch.precision)
+            lut = dict(zip(known.tolist(), np.rint(ests).astype(
+                np.int64).tolist()))
+            for i, b in enumerate(banks.tolist()):
+                if b >= 0:
+                    out[i] = lut[b]
+        return out
+
+    def pfcount(self, days) -> np.ndarray:
+        """Per-lecture-day unique-attendee estimates: int64[B]."""
+        t0 = time.perf_counter()
+        days = np.atleast_1d(np.asarray(days, dtype=np.int64))
+        self._check_batch(len(days))
+        epoch = self.pin()
+        out = self._estimates(epoch, days)
+        if self._auditor is not None:
+            self._auditor.check_pfcount(epoch, days, out)
+        self._note("pfcount", len(days), epoch, t0)
+        return out
+
+    def occupancy(self) -> Dict[int, int]:
+        """The full per-lecture occupancy table {day: unique} from one
+        batched histogram pass over every registered bank."""
+        t0 = time.perf_counter()
+        epoch = self.pin()
+        if not epoch.bank_of:
+            self._note("occupancy", 0, epoch, t0)
+            return {}
+        days = np.fromiter(epoch.bank_of.keys(), dtype=np.int64,
+                           count=len(epoch.bank_of))
+        banks = np.fromiter(epoch.bank_of.values(), dtype=np.int64,
+                            count=len(epoch.bank_of))
+        ests = np.rint(estimates_from_rows(
+            epoch.hll_regs[banks], epoch.precision)).astype(np.int64)
+        out = {int(d): int(e) for d, e in zip(days, ests)}
+        if self._auditor is not None:
+            self._auditor.check_pfcount(epoch, days, ests)
+        self._note("occupancy", len(out), epoch, t0)
+        return out
+
+    def attendance_rate(self, roster_size: int = 0) -> Dict[int, float]:
+        """{day: unique/roster} — the paper's attendance-rate table.
+        ``roster_size`` 0 uses the epoch's recorded preload size."""
+        t0 = time.perf_counter()
+        epoch = self.pin()
+        denom = int(roster_size) or epoch.roster_size
+        table = {}
+        if denom > 0 and epoch.bank_of:
+            days = np.fromiter(epoch.bank_of.keys(), dtype=np.int64,
+                               count=len(epoch.bank_of))
+            banks = np.fromiter(epoch.bank_of.values(), dtype=np.int64,
+                                count=len(epoch.bank_of))
+            ests = estimates_from_rows(epoch.hll_regs[banks],
+                                       epoch.precision)
+            table = {int(d): float(e) / denom
+                     for d, e in zip(days, ests)}
+        self._note("rate", len(table), epoch, t0)
+        return table
+
+    def stats(self) -> Dict:
+        """Epoch metadata + validity counters (the doctor/health verb
+        of the query surface)."""
+        t0 = time.perf_counter()
+        epoch = self.pin()
+        valid = invalid = None
+        if epoch.counts is not None:
+            from attendance_tpu.models.fused import decode_counts
+            try:
+                valid, invalid = decode_counts(epoch.counts)
+            except (IndexError, ValueError):
+                pass  # mesh-shaped counters: stats stays metadata-only
+        out = {
+            "epoch": epoch.seq,
+            "source": epoch.source,
+            "published_at": epoch.published_at,
+            "age_s": round(epoch.age_s(), 6),
+            "events": epoch.events,
+            "banks": len(epoch.bank_of),
+            "roster_size": epoch.roster_size,
+            "valid": valid,
+            "invalid": invalid,
+            "staleness_ceiling_s": self.staleness_ceiling_s,
+        }
+        self._note("stats", 1, epoch, t0)
+        return out
+
+    def execute(self, verb: str, *, keys=None, days=None,
+                roster_size: int = 0):
+        """Dispatch one request by verb name (the wire surfaces'
+        single entry point)."""
+        if verb == "exists":
+            return self.bf_exists(keys if keys is not None else ())
+        if verb == "pfcount":
+            return self.pfcount(days if days is not None else ())
+        if verb == "occupancy":
+            return self.occupancy()
+        if verb == "rate":
+            return self.attendance_rate(roster_size)
+        if verb == "stats":
+            return self.stats()
+        raise ValueError(f"unknown query verb {verb!r}")
+
+
+def resolve_days(values: Sequence) -> np.ndarray:
+    """Lecture-day vector from mixed inputs: ints pass through,
+    reference-style ``LECTURE_YYYYMMDD`` strings resolve via the shared
+    one-key-space rule (fast_path._resolve_day's contract)."""
+    from attendance_tpu.pipeline.events import _lecture_to_day
+
+    out = np.empty(len(values), dtype=np.int64)
+    for i, v in enumerate(values):
+        out[i] = _lecture_to_day(v) if isinstance(v, str) else int(v)
+    return out

@@ -1,0 +1,187 @@
+"""Epoch-pinned read mirror: lock-free snapshot isolation for reads.
+
+One :class:`ReadMirror` sits between the write engine and every reader
+(query executors, scrape-time health/audit gauges). Writers publish
+immutable :class:`Epoch` objects; the publish is a single attribute
+assignment (atomic under the GIL), and a reader pins an epoch simply by
+holding the reference ``pin()`` returned — there is no unpin call, no
+reader registration, and nothing for the hot loop to wait on.
+
+Register buffers are double-buffered: ``publish`` recycles the
+register array of the previous-previous epoch when no reader still
+references that epoch (checked via its refcount), so a steady
+barrier cadence republished into two alternating buffers allocates
+nothing — while a reader that pins an old epoch across many barriers
+simply forces a fresh allocation instead of ever observing a torn row.
+
+The Bloom words are run-static between preloads (the fused hot loop
+never BF.ADDs), so epochs share one host words array by reference; a
+re-preload publishes a new array, and old epochs keep answering from
+the roster they were published under.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Epoch:
+    """One immutable published read view. Readers treat every field as
+    frozen; the mirror only recycles ``hll_regs`` buffers of epochs no
+    reader references anymore."""
+
+    __slots__ = ("seq", "published_at", "events", "bloom_words",
+                 "hll_regs", "counts", "bank_of", "day_truth",
+                 "roster_size", "params", "precision", "source")
+
+    def __init__(self, *, seq: int, events: int,
+                 bloom_words: Optional[np.ndarray],
+                 hll_regs: np.ndarray, counts: Optional[np.ndarray],
+                 bank_of: Dict[int, int], params, precision: int,
+                 roster_size: int = 0,
+                 day_truth: Optional[Dict[int, float]] = None,
+                 source: str = "live",
+                 published_at: Optional[float] = None):
+        self.seq = seq
+        self.published_at = (time.time() if published_at is None
+                             else published_at)
+        self.events = events
+        self.bloom_words = bloom_words
+        self.hll_regs = hll_regs
+        self.counts = counts
+        self.bank_of = bank_of
+        self.day_truth = day_truth
+        self.roster_size = roster_size
+        self.params = params
+        self.precision = precision
+        self.source = source
+
+    def age_s(self, now: Optional[float] = None) -> float:
+        return (time.time() if now is None else now) - self.published_at
+
+
+class ReadMirror:
+    """Holder of the current epoch + the double-buffer recycler.
+
+    ``pin()`` is the whole read-side API: one attribute load. The
+    publish side is serialized by a small lock (callers are the
+    snapshot writer thread and cold paths — preload, restore, explicit
+    publishes — never the hot loop)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._current: Optional[Epoch] = None
+        self._previous: Optional[Epoch] = None
+        self._seq = 0
+
+    # -- read side -----------------------------------------------------------
+    def pin(self) -> Optional[Epoch]:
+        """The current epoch (None before the first publish). Holding
+        the returned object IS the pin: its arrays stay valid for as
+        long as the reference lives."""
+        return self._current
+
+    def staleness_s(self) -> float:
+        """Age of the current epoch; NaN before the first publish (a
+        gauge rendering 0.0 would claim perfect freshness)."""
+        e = self._current
+        return float("nan") if e is None else e.age_s()
+
+    # -- write side ----------------------------------------------------------
+    def _recycled_regs(self, shape, dtype) -> np.ndarray:
+        """A register buffer for the next epoch: the previous-previous
+        epoch's array when provably unpinned, else a fresh one.
+
+        Refcount check: when ``self._previous`` is the only external
+        holder of that Epoch (getrefcount sees our reference + its own
+        argument), no reader can reach its arrays once we drop it —
+        overwriting its regs buffer is then invisible to every reader.
+        """
+        import sys
+
+        prev = self._previous
+        if (prev is not None and prev.hll_regs.shape == shape
+                and prev.hll_regs.dtype == dtype
+                # self._previous + this local + getrefcount's argument
+                # = no outside pinner of the epoch, and the Epoch slot
+                # + argument = no reader kept the bare array either.
+                and sys.getrefcount(prev) == 3
+                and sys.getrefcount(prev.hll_regs) == 2):
+            return prev.hll_regs
+        return np.empty(shape, dtype)
+
+    def publish(self, *, regs: np.ndarray, events: int,
+                bank_of: Dict[int, int], params, precision: int,
+                bloom_words: Optional[np.ndarray] = None,
+                counts: Optional[np.ndarray] = None,
+                roster_size: Optional[int] = None,
+                day_truth: Optional[Dict[int, float]] = None,
+                source: str = "live",
+                copy_regs: bool = True) -> Epoch:
+        """Publish the next epoch from the writer's host state.
+
+        ``regs`` is the writer's PRIVATE accumulation mirror and may be
+        mutated by later deltas, so it is copied into a (usually
+        recycled) read buffer; ``copy_regs=False`` hands ownership of
+        ``regs`` to the epoch (chain readers building a fresh array per
+        reload). ``bloom_words``/``counts``/``roster_size`` default to
+        the previous epoch's (run-static filter; sparse counter
+        updates)."""
+        regs = np.asarray(regs, dtype=np.uint8)
+        with self._lock:
+            prev = self._current
+            if copy_regs:
+                buf = self._recycled_regs(regs.shape, regs.dtype)
+                np.copyto(buf, regs)
+            else:
+                buf = regs
+            if bloom_words is None and prev is not None:
+                bloom_words = prev.bloom_words
+            if counts is None and prev is not None:
+                counts = prev.counts
+            if roster_size is None:
+                roster_size = prev.roster_size if prev is not None else 0
+            self._seq += 1
+            epoch = Epoch(
+                seq=self._seq, events=events, bloom_words=bloom_words,
+                hll_regs=buf,
+                counts=(None if counts is None
+                        else np.array(counts, copy=True)),
+                bank_of=dict(bank_of), params=params,
+                precision=precision, roster_size=int(roster_size),
+                day_truth=(None if day_truth is None
+                           else dict(day_truth)),
+                source=source)
+            # Shift the double buffer: current -> previous (recycle
+            # candidate at the NEXT publish), previous dropped.
+            self._previous = prev
+            self._current = epoch  # the atomic pointer swap
+            return epoch
+
+    def register_gauges(self, telemetry) -> None:
+        register_staleness_gauges(telemetry, self)
+
+
+def register_staleness_gauges(telemetry, source) -> None:
+    """Export ``attendance_read_staleness_seconds`` (current epoch age;
+    NaN before the first publish) and the epoch sequence gauge for any
+    epoch source (ReadMirror or a chain reader). Idempotent —
+    set_function replaces the callback."""
+    telemetry.registry.gauge(
+        "attendance_read_staleness_seconds",
+        help="Age of the published read epoch (bounded by the "
+        "snapshot barrier cadence; NaN before the first publish)"
+    ).set_function(source.staleness_s)
+
+    def seq() -> float:
+        e = source.pin()
+        return float(e.seq) if e is not None else 0.0
+
+    telemetry.registry.gauge(
+        "attendance_read_epoch_seq",
+        help="Monotonic sequence number of the published read "
+        "epoch").set_function(seq)

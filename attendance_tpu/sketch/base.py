@@ -275,6 +275,15 @@ class SketchStore(abc.ABC):
             self._auditor.check_pfcount(keys, out)
         return out
 
+    def pfcount_many(self, keys: Sequence[str]) -> List[int]:
+        """Batched per-key PFCOUNT: one estimate per key (NOT the
+        union ``pfcount(*keys)`` computes) — the query plane's batched
+        read entry point over generic stores. The default loops
+        :meth:`pfcount` so every answer still crosses the audit
+        chokepoint; banked backends override with one vectorized
+        histogram pass (TpuSketchStore)."""
+        return [self.pfcount(k) for k in keys]
+
     def _pf_create(self, key: str) -> int:
         """PFADD with no members (create-only form); the generic
         backends treat it as a no-op returning 0."""

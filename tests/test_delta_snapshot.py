@@ -383,3 +383,128 @@ def test_store_chain_crash_consistency_and_health_gauges(
             in t.render()
     finally:
         obs.disable()
+
+
+def test_reader_vs_compactor_interleaving(tmp_path):
+    """Merge-on-read under churn (ISSUE 7 satellite): a separate
+    reader hammering chain reloads while the ingest writer appends
+    deltas AND periodically compacts the chain into a fresh base must
+    always observe a WHOLE published epoch — reloads never fail, the
+    served event count never regresses (group-commit order), and the
+    final reload equals the writer's own final state. The vanished-
+    delta race (manifest read -> compaction GC -> file open) is
+    absorbed by the reader's retry (see the next test for the
+    deterministic version)."""
+    import threading
+    import time
+
+    from attendance_tpu.serve.chain import ChainEpochSource
+    from attendance_tpu.serve.engine import QueryEngine
+
+    roster, frames = _mkframes(seed=71)
+    frames = list(frames)
+    snap = tmp_path / "snaps"
+    config = _mkcfg(str(snap), every=1, snapshot_compact_every=3)
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    producer.send(frames[0])
+    pipe.run(max_events=BATCH, idle_timeout_s=0.5)  # base on disk
+
+    src = ChainEpochSource(str(snap))
+    stop = threading.Event()
+    events_seen, errors = [], []
+
+    def reader() -> None:
+        try:
+            while not stop.is_set():
+                if src.reload():
+                    events_seen.append(src.pin().events)
+        except Exception as exc:  # noqa: BLE001 - the assertion
+            errors.append(repr(exc))
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    for f in frames[1:]:
+        producer.send(f)
+    # every=1 + compact_every=3: multiple compaction folds (base
+    # rewrite + delta GC) land WHILE the reader reloads.
+    pipe.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    time.sleep(0.2)
+    stop.set()
+    t.join(timeout=30.0)
+    assert not errors, f"reader failed mid-compaction: {errors[:2]}"
+    assert events_seen, "reader never observed a republished chain"
+    assert events_seen == sorted(events_seen), \
+        "served event count regressed across reloads"
+    src.reload()
+    final = QueryEngine(src).occupancy()
+    assert final == {day: pipe.count(day) for day in pipe.lecture_days()}
+    assert src.pin().events == NUM_EVENTS
+    pipe.cleanup()
+
+
+def test_reader_retries_vanished_delta(tmp_path, monkeypatch):
+    """Deterministic half of the reader-vs-compactor race: the FIRST
+    chain read observes a manifest whose named delta was GC'd by a
+    concurrent compaction (ValueError from the loader); the reader
+    must re-read the fresh manifest and serve the new epoch — never
+    propagate the transient error, never serve a mix."""
+    import attendance_tpu.pipeline.fast_path as fp
+    from attendance_tpu.serve.chain import ChainEpochSource
+
+    roster, frames = _mkframes(seed=73)
+    snap = tmp_path / "snaps"
+    config = _mkcfg(str(snap))
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    expect = {day: pipe.count(day) for day in pipe.lecture_days()}
+    pipe.cleanup()
+
+    real = fp.read_chain_state
+    calls = []
+
+    def flaky(*args, **kwargs):
+        calls.append(1)
+        if len(calls) == 1:
+            raise ValueError(
+                "chain manifest names delta-0042.npz but the delta "
+                "file is missing — snapshot directory is corrupt")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(fp, "read_chain_state", flaky)
+    src = ChainEpochSource(str(snap))
+    assert len(calls) >= 2, "reader did not retry the vanished delta"
+    from attendance_tpu.serve.engine import QueryEngine
+    assert QueryEngine(src).occupancy() == expect
+
+
+def test_reader_fails_loudly_on_corrupt_chain(tmp_path):
+    """A PERMANENTLY missing manifest-named delta (REAL corruption, not
+    the transient compaction race) must surface as the reader's
+    retry-exhaustion RuntimeError at construction — not a silent None
+    epoch."""
+    from attendance_tpu.serve.chain import ChainEpochSource
+
+    roster, frames = _mkframes(seed=77)
+    snap = tmp_path / "snaps"
+    config = _mkcfg(str(snap))
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    pipe.cleanup()
+    chain = json.loads((snap / CHAIN_MANIFEST).read_text())
+    assert chain["deltas"]
+    (snap / chain["deltas"][0]).unlink()  # permanent corruption
+    with pytest.raises(RuntimeError, match="kept moving"):
+        ChainEpochSource(str(snap))

@@ -1,0 +1,367 @@
+"""Query-serving plane (attendance_tpu/serve): epoch mirror semantics,
+vectorized executor correctness against the write engine's own answers,
+the binary batch RPC + HTTP surfaces, merge-on-read chain serving, the
+read-path audit, and the doctor/SLO hooks.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from attendance_tpu import obs
+from attendance_tpu.config import Config
+from attendance_tpu.models.bloom import (
+    BloomParams, bloom_contains_words, bloom_contains_words_np,
+    bloom_packed_fill_fraction, bloom_packed_fill_fraction_np,
+    derive_bloom_params)
+from attendance_tpu.models.hll import (
+    best_histogram, estimate_from_histogram, estimates_from_rows)
+from attendance_tpu.pipeline.fast_path import FusedPipeline
+from attendance_tpu.pipeline.loadgen import generate_frames
+from attendance_tpu.serve.engine import NoEpoch, QueryEngine
+from attendance_tpu.serve.mirror import ReadMirror
+from attendance_tpu.serve.rpc import QueryClient, QueryServer
+from attendance_tpu.transport.memory_broker import (
+    MemoryBroker, MemoryClient)
+
+NUM_EVENTS, BATCH = 16_384, 2_048
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    obs.disable()
+    yield
+    obs.disable()
+
+
+def _mkcfg(snap_dir="", **kw):
+    return Config(bloom_filter_capacity=20_000,
+                  transport_backend="memory",
+                  snapshot_dir=snap_dir,
+                  snapshot_every_batches=2 if snap_dir else 0, **kw)
+
+
+def _run_pipe(config, seed=7, num_banks=8):
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=num_banks)
+    roster, frames = generate_frames(
+        NUM_EVENTS, BATCH, roster_size=6_000, num_lectures=6,
+        invalid_fraction=0.15, seed=seed)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    return pipe, roster
+
+
+# -- numpy read kernels vs device kernels ------------------------------------
+
+def test_numpy_probe_matches_device_probe():
+    """The host packed-word probe must answer bit-identically to the
+    device kernel (shared bloom_positions) — the query plane's whole
+    correctness story rests on this."""
+    import jax.numpy as jnp
+    from attendance_tpu.models.bloom import (
+        bloom_add_packed, bloom_packed_init)
+
+    params = derive_bloom_params(5_000, 0.01, "blocked")
+    words = bloom_packed_init(params)
+    rng = np.random.default_rng(1)
+    members = rng.choice(1 << 31, 3_000, replace=False).astype(np.uint32)
+    words = bloom_add_packed(words, jnp.asarray(members), params)
+    probes = np.concatenate([
+        members[:500],
+        rng.integers(1 << 31, 1 << 32, 500).astype(np.uint32)])
+    dev = np.asarray(bloom_contains_words(words, jnp.asarray(probes),
+                                          params))
+    host = bloom_contains_words_np(np.asarray(words), probes, params)
+    assert (dev == host).all()
+    assert host[:500].all()  # no false negatives on members
+    assert bloom_packed_fill_fraction_np(np.asarray(words)) == \
+        pytest.approx(float(bloom_packed_fill_fraction(words)), rel=1e-6)
+
+
+def test_batched_histogram_estimates_match_scalar():
+    rng = np.random.default_rng(2)
+    rows = rng.integers(0, 30, size=(5, 1 << 14)).astype(np.uint8)
+    batched = estimates_from_rows(rows, 14)
+    for i in range(5):
+        hist = np.asarray(best_histogram(rows[i:i + 1], 14))[0]
+        assert batched[i] == pytest.approx(
+            estimate_from_histogram(hist, 14), rel=1e-9)
+
+
+# -- mirror semantics --------------------------------------------------------
+
+def test_mirror_pin_survives_later_publishes():
+    """A pinned epoch's registers must stay intact across publishes —
+    the recycler may only reuse buffers no reader references."""
+    mirror = ReadMirror()
+    params = derive_bloom_params(1000, 0.01, "blocked")
+    regs = np.full((4, 16), 1, np.uint8)
+    mirror.publish(regs=regs, events=1, bank_of={1: 0}, params=params,
+                   precision=14, bloom_words=np.zeros(4, np.uint32))
+    pinned = mirror.pin()
+    assert pinned.seq == 1 and (pinned.hll_regs == 1).all()
+    for gen in (2, 3, 4, 5):
+        mirror.publish(regs=np.full((4, 16), gen, np.uint8),
+                       events=gen, bank_of={1: 0}, params=params,
+                       precision=14)
+    # The old pin still reads its own epoch's values...
+    assert (pinned.hll_regs == 1).all()
+    assert pinned.events == 1
+    # ...and the current epoch reads the latest.
+    cur = mirror.pin()
+    assert cur.seq == 5 and (cur.hll_regs == 5).all()
+    assert cur.bloom_words is not None  # carried forward by reference
+
+
+def test_mirror_recycles_unpinned_buffers():
+    """Steady republishing with no outside pinner must reuse the
+    double buffer, not allocate per epoch."""
+    mirror = ReadMirror()
+    params = derive_bloom_params(1000, 0.01, "blocked")
+    for gen in range(6):
+        mirror.publish(regs=np.full((4, 16), gen, np.uint8),
+                       events=gen, bank_of={}, params=params,
+                       precision=14)
+    seen = set()
+    for gen in range(6, 12):
+        mirror.publish(regs=np.full((4, 16), gen, np.uint8),
+                       events=gen, bank_of={}, params=params,
+                       precision=14)
+        seen.add(id(mirror.pin().hll_regs))
+    assert len(seen) <= 2  # alternating between two buffers
+
+
+def test_staleness_nan_before_first_publish():
+    mirror = ReadMirror()
+    assert np.isnan(mirror.staleness_s())
+    engine = QueryEngine(mirror)
+    with pytest.raises(NoEpoch):
+        engine.bf_exists(np.array([1], np.uint32))
+
+
+# -- live pipeline serving ---------------------------------------------------
+
+def test_engine_answers_match_pipeline(tmp_path):
+    """Occupancy/PFCOUNT from the epoch mirror must equal the write
+    engine's own device answers, and roster membership must carry zero
+    false negatives — the read plane serves the same truth the hot
+    loop holds."""
+    pipe, roster = _run_pipe(_mkcfg(str(tmp_path / "snaps")))
+    try:
+        engine = QueryEngine(pipe.read_mirror)
+        epoch = engine.pin()
+        assert epoch.events == NUM_EVENTS
+        exact = {d: pipe.count(d) for d in pipe.lecture_days()}
+        assert engine.occupancy() == exact
+        days = np.array(pipe.lecture_days(), np.int64)
+        assert engine.pfcount(days).tolist() == \
+            [exact[int(d)] for d in days]
+        assert engine.pfcount([123]).tolist() == [0]  # unknown day
+        answers = engine.bf_exists(roster)
+        assert answers.all(), "read-path false negatives on roster"
+        rates = engine.attendance_rate()
+        assert set(rates) == set(exact)
+        assert all(0.0 < r <= 1.5 for r in rates.values())
+        st = engine.stats()
+        assert st["events"] == NUM_EVENTS
+        assert st["roster_size"] == len(roster)
+    finally:
+        pipe.cleanup()
+
+
+def test_rpc_roundtrip_and_chunking(tmp_path):
+    pipe, roster = _run_pipe(_mkcfg(str(tmp_path / "snaps"),
+                                    serve_port=-1), seed=9)
+    try:
+        assert pipe.query_server is not None
+        engine = pipe.query_engine
+        # batch_max far below the probe size: the client must chunk
+        # transparently and reassemble in order.
+        qc = QueryClient(pipe.query_server.address, batch_max=257)
+        probes = np.concatenate([
+            roster[:1500],
+            np.arange(1 << 31, (1 << 31) + 1500, dtype=np.uint32)])
+        assert (qc.bf_exists(probes)
+                == engine.bf_exists(probes)).all()
+        days = pipe.lecture_days()
+        assert qc.pfcount(days).tolist() == \
+            engine.pfcount(days).tolist()
+        assert qc.occupancy() == engine.occupancy()
+        rates = qc.attendance_rate()
+        assert rates == pytest.approx(engine.attendance_rate())
+        assert qc.stats()["events"] == NUM_EVENTS
+        qc.close()
+    finally:
+        pipe.cleanup()
+
+
+def test_http_query_routes(tmp_path):
+    pipe, roster = _run_pipe(_mkcfg(str(tmp_path / "snaps"),
+                                    serve_port=-1, metrics_port=-1),
+                             seed=11)
+    try:
+        port = obs.get().http_port
+        base = f"http://127.0.0.1:{port}"
+        occ = json.loads(urllib.request.urlopen(
+            f"{base}/query/occupancy", timeout=10).read())
+        assert {int(k): v for k, v in occ.items()} == \
+            pipe.query_engine.occupancy()
+        ex = json.loads(urllib.request.urlopen(
+            f"{base}/query/exists?keys={roster[0]},{1 << 31}",
+            timeout=10).read())
+        assert ex[0] is True
+        day = pipe.lecture_days()[0]
+        pf = json.loads(urllib.request.urlopen(
+            f"{base}/query/pfcount?days=LECTURE_{day}",
+            timeout=10).read())
+        assert pf == [pipe.count(day)]
+        req = urllib.request.Request(
+            f"{base}/query", method="POST",
+            data=json.dumps({"verb": "pfcount",
+                             "days": [int(day), 123]}).encode())
+        doc = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert doc["result"] == [pipe.count(day), 0]
+        # the scrape surface still works beside the query routes
+        body = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=10).read().decode()
+        assert "attendance_read_staleness_seconds" in body
+        assert "attendance_query_requests_total" in body
+    finally:
+        pipe.cleanup()
+
+
+def test_read_audit_zero_fn_and_measured_fpr(tmp_path):
+    """Sampled read answers cross-check against the exact shadow:
+    roster queries must produce zero read-path false negatives, and
+    disjoint-range probes a finite measured read FPR within budget."""
+    pipe, roster = _run_pipe(_mkcfg(str(tmp_path / "snaps"),
+                                    serve_port=-1, audit_sample=1.0),
+                             seed=13)
+    try:
+        engine = pipe.query_engine
+        engine.bf_exists(roster)
+        rng = np.random.default_rng(5)
+        engine.bf_exists(
+            rng.integers(1 << 31, 1 << 32, 20_000).astype(np.uint32))
+        engine.pfcount(np.array(pipe.lecture_days(), np.int64))
+        reg = obs.get().registry
+        assert reg.counter(
+            "attendance_query_false_negatives_total").value == 0
+        assert reg.counter(
+            "attendance_query_audited_total").value > 0
+        fpr = reg.gauge("attendance_query_measured_fpr").read()
+        assert np.isfinite(fpr) and fpr <= 0.01
+        # per-day read HLL error vs the epoch's truth snapshot
+        errs = [m.read() for name, kind, help, members
+                in reg.collect()
+                if name == "attendance_query_hll_rel_error"
+                for m in members]
+        assert errs and max(errs) <= 0.05
+    finally:
+        pipe.cleanup()
+
+
+def test_health_gauges_read_from_epoch(tmp_path):
+    """The scrape-time health gauges must answer from the pinned epoch
+    under checkpointing (the torn-row fix), and still agree with the
+    estimator methods."""
+    pipe, roster = _run_pipe(_mkcfg(str(tmp_path / "snaps"),
+                                    metrics_port=-1), seed=15)
+    try:
+        reg = obs.get().registry
+        fpr = reg.gauge("attendance_bloom_estimated_fpr").read()
+        assert fpr == pytest.approx(pipe.estimated_fpr(), rel=1e-5)
+        est = reg.gauge("attendance_hll_estimate").read()
+        assert est == pytest.approx(
+            sum(pipe.count_all().values()), rel=1e-6)
+        stale = reg.gauge("attendance_read_staleness_seconds").read()
+        assert np.isfinite(stale) and stale >= 0.0
+        assert reg.gauge("attendance_read_epoch_seq").read() >= 1.0
+    finally:
+        pipe.cleanup()
+
+
+def test_concurrent_publish_and_read(tmp_path):
+    """Readers hammering the engine while epochs publish must only
+    ever see whole epochs: every occupancy answer equals the table of
+    SOME published epoch, never a mix."""
+    mirror = ReadMirror()
+    params = derive_bloom_params(1000, 0.01, "blocked")
+    # Every epoch's registers are uniform (one value per generation),
+    # so a reader observing two values inside one pinned epoch has
+    # caught a torn buffer — the exact failure the recycler must
+    # make impossible.
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        while not stop.is_set():
+            epoch = mirror.pin()
+            if epoch is None:
+                continue
+            regs = epoch.hll_regs
+            lo, hi = int(regs.min()), int(regs.max())
+            if lo != hi:  # a torn buffer mixes two generations
+                torn.append((epoch.seq, lo, hi))
+                return
+
+    threads = [threading.Thread(target=reader, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for gen in range(1, 40):
+            mirror.publish(
+                regs=np.full((4, 1 << 14), gen % 31, np.uint8),
+                events=gen, bank_of={1: 0, 2: 1},
+                params=params, precision=14)
+    finally:
+        stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not torn, f"readers observed torn epochs: {torn[:3]}"
+
+
+def test_pfcount_many_matches_scalar():
+    from attendance_tpu.sketch.tpu_store import TpuSketchStore
+
+    store = TpuSketchStore(_mkcfg())
+    rng = np.random.default_rng(3)
+    for i, key in enumerate(("hll:a", "hll:b", "hll:c")):
+        store.pfadd_many(key, rng.integers(0, 1 << 31, 500 * (i + 1)))
+    keys = ["hll:a", "hll:b", "hll:missing", "hll:c"]
+    assert store.pfcount_many(keys) == \
+        [store.pfcount(k) for k in keys]
+
+
+def test_slo_alias_and_doctor_rows(tmp_path):
+    from attendance_tpu.obs.slo import doctor_report, parse_slo
+
+    slo = parse_slo("read_staleness<=2.5")
+    assert slo.metric == "attendance_read_staleness_seconds"
+    assert slo.threshold == 2.5
+    prom = tmp_path / "q.prom"
+    prom.write_text(
+        "attendance_read_staleness_seconds 1.5\n"
+        "attendance_query_false_negatives_total 0\n"
+        "attendance_query_measured_fpr 0.004\n"
+        'attendance_stage_latency_seconds_bucket{stage="query",'
+        'le="0.001024"} 100\n'
+        'attendance_stage_latency_seconds_bucket{stage="query",'
+        'le="+Inf"} 100\n'
+        'attendance_stage_latency_seconds_sum{stage="query"} 0.1\n'
+        'attendance_stage_latency_seconds_count{stage="query"} 100\n')
+    text, ok = doctor_report([str(prom)], query_p99_ceiling=10.0,
+                             staleness_ceiling=2.0)
+    assert ok
+    assert "query p99" in text and "read epoch staleness" in text
+    assert "query-path false negatives" in text
+    text, ok = doctor_report([str(prom)], staleness_ceiling=1.0)
+    assert not ok  # 1.5s of staleness breaches a 1.0s ceiling

@@ -1,0 +1,196 @@
+"""Query-plane smoke (CI gate): serve from a LIVE ingest run.
+
+One process, real concurrency, no mocks:
+
+1. a fused pipeline ingests a binary backlog with delta checkpointing
+   (the barriers publish read epochs) and the query plane serving on
+   an ephemeral binary RPC port, full-shadow audited
+   (``--audit-sample 1.0``) with telemetry artifacts in the workdir;
+2. a reader thread fires mixed point (batch 1/64/4096 BF.EXISTS) and
+   table (occupancy / attendance-rate / pfcount) batches over the RPC
+   for the whole ingest — every sampled answer cross-checks against
+   the exact shadow;
+3. hard invariants: zero read-path false negatives, measured read FPR
+   within the 1% budget, every occupancy answer internally consistent
+   (a whole epoch, never a mix);
+4. ``doctor`` replays the run's own prom + alert artifacts with the
+   query-p99 latency ceiling and the read-staleness gauge gated.
+
+Exit 0 = all gates pass. The workdir (serve log + artifacts) is
+uploaded by CI on failure.
+Run on CPU: ``JAX_PLATFORMS=cpu python tools/query_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+NUM_EVENTS, BATCH = 262_144, 8_192
+SEED = 47
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", default="/tmp/query_smoke")
+    ap.add_argument("--query-p99-ceiling", type=float, default=0.5,
+                    help="doctor gate on the query-stage p99 (s)")
+    ap.add_argument("--staleness-ceiling", type=float, default=30.0,
+                    help="doctor gate on the read epoch's age at the "
+                    "final scrape (s)")
+    args = ap.parse_args()
+    work = Path(args.workdir)
+    work.mkdir(parents=True, exist_ok=True)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s - %(levelname)s - %(message)s",
+        handlers=[logging.StreamHandler(),
+                  logging.FileHandler(work / "serve.log")])
+    log = logging.getLogger("query_smoke")
+
+    import numpy as np
+
+    from attendance_tpu import obs
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.serve.rpc import QueryClient
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    prom = work / "serve.prom"
+    alerts = work / "alerts.jsonl"
+    config = Config(
+        bloom_filter_capacity=50_000, transport_backend="memory",
+        snapshot_dir=str(work / "snaps"), snapshot_every_batches=4,
+        serve_port=-1, audit_sample=1.0, metrics_prom=str(prom),
+        alert_log=str(alerts), read_staleness_ceiling_s=60.0)
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=16)
+    roster, frames = generate_frames(
+        NUM_EVENTS, BATCH, roster_size=20_000, num_lectures=8,
+        invalid_fraction=0.1, seed=SEED)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for frame in frames:
+        producer.send(frame)
+
+    rng = np.random.default_rng(SEED)
+    mix = np.where(
+        rng.random(1 << 15) < 0.5, rng.choice(roster, 1 << 15),
+        rng.integers(1 << 31, 1 << 32, size=1 << 15,
+                     dtype=np.uint32)).astype(np.uint32)
+    stop = threading.Event()
+    stats = {"point": 0, "tables": 0, "errors": []}
+
+    def reader() -> None:
+        qc = QueryClient(pipe.query_server.address)
+        i = 0
+        try:
+            while not stop.is_set():
+                for bs in (1, 64, 4096):
+                    chunk = mix[(i * bs) % (1 << 14):][:bs]
+                    qc.bf_exists(chunk)
+                    stats["point"] += len(chunk)
+                occ = qc.occupancy()
+                rates = qc.attendance_rate()
+                qc.pfcount(sorted(occ) or [0])
+                # Each verb pins its OWN epoch, and a barrier may
+                # publish between the two RPCs — but the day set only
+                # ever grows, so consecutive epochs' tables must be
+                # subset-related; anything else is a torn answer.
+                if occ and not (set(rates) <= set(occ)
+                                or set(occ) <= set(rates)):
+                    stats["errors"].append(
+                        f"rate table days {sorted(rates)} vs "
+                        f"occupancy days {sorted(occ)}: neither is a "
+                        "subset of the other")
+                stats["tables"] += 3
+                i += 1
+        except Exception as exc:  # noqa: BLE001 - smoke must report
+            stats["errors"].append(repr(exc))
+        finally:
+            qc.close()
+
+    t_reader = threading.Thread(target=reader, daemon=True)
+    t_reader.start()
+    t0 = time.perf_counter()
+    pipe.run(max_events=NUM_EVENTS, idle_timeout_s=1.0)
+    wall = time.perf_counter() - t0
+    stop.set()
+    t_reader.join(timeout=15.0)
+
+    reg = obs.get().registry
+    read_fn = reg.counter(
+        "attendance_query_false_negatives_total").value
+    audited = reg.counter("attendance_query_audited_total").value
+    try:
+        read_fpr = float(reg.gauge(
+            "attendance_query_measured_fpr").read())
+    except Exception:
+        read_fpr = float("nan")
+    staleness = float(pipe.read_mirror.staleness_s())
+    log.info("ingested %d events in %.2fs (%.0f ev/s) while serving "
+             "%d point answers + %d tables; audited=%d read_fn=%d "
+             "read_fpr=%s staleness=%.2fs",
+             pipe.metrics.events, wall,
+             pipe.metrics.events / max(wall, 1e-9), stats["point"],
+             stats["tables"], audited, read_fn, read_fpr, staleness)
+    pipe.cleanup()
+    obs.disable()  # flush the final prom block before doctor reads it
+
+    failures = list(stats["errors"])
+    if pipe.metrics.events < NUM_EVENTS:
+        failures.append(f"ingest incomplete: {pipe.metrics.events}"
+                        f"/{NUM_EVENTS}")
+    if stats["point"] == 0 or stats["tables"] == 0:
+        failures.append("reader answered nothing — serve plane dead")
+    if audited == 0:
+        failures.append("read audit never sampled an answer")
+    if read_fn != 0:
+        failures.append(f"read-path false negatives: {read_fn}")
+    import math
+    if not math.isnan(read_fpr) and read_fpr > 0.01:
+        failures.append(f"read-path measured FPR {read_fpr} > 0.01")
+
+    doctor = subprocess.run(
+        [sys.executable, "-m", "attendance_tpu.cli", "doctor",
+         str(prom), str(alerts),
+         "--query-p99-ceiling", str(args.query_p99_ceiling),
+         "--staleness-ceiling", str(args.staleness_ceiling)],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    log.info("doctor verdict:\n%s", doctor.stdout.strip())
+    if doctor.returncode != 0:
+        failures.append(f"doctor exit {doctor.returncode}: "
+                        f"{doctor.stderr.strip()[-500:]}")
+
+    (work / "verdict.json").write_text(json.dumps({
+        "events": pipe.metrics.events,
+        "point_answers": stats["point"],
+        "tables": stats["tables"],
+        "audited": audited,
+        "read_false_negatives": int(read_fn),
+        "read_measured_fpr": (None if math.isnan(read_fpr)
+                              else read_fpr),
+        "staleness_s": (None if math.isnan(staleness) else staleness),
+        "failures": failures,
+    }, indent=2))
+    if failures:
+        for f in failures:
+            log.error("FAIL: %s", f)
+        return 1
+    log.info("query smoke PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
