@@ -363,6 +363,74 @@ def cmd_serve(args) -> None:
         source.stop()
 
 
+def cmd_federate(args) -> None:
+    """Federation aggregator: subscribe to the fence-gossip topic,
+    fold every worker's merge frames (Bloom word-OR / HLL register-max
+    CRDT joins — commutative, associative, idempotent) into one global
+    view, declare peers silent past --fed-dead-after-s dead (orphaning
+    their shards at a bumped map version and recovering their durable
+    base+delta chains), and serve the merged state through the query
+    plane: binary batch RPC on --serve-port plus /query/* JSON routes
+    when --metrics-port is live. ``--stats-json PATH`` publishes the
+    aggregator's live state (per-worker ledgers, shard map, fold
+    counters) as an atomically-replaced JSON file — the federation
+    soak's takeover gate reads it."""
+    import json as _json
+    import os
+    import sys
+    import time as _time
+
+    from attendance_tpu import obs
+    from attendance_tpu.federation.gossip import Aggregator
+    from attendance_tpu.serve.engine import QueryEngine
+    from attendance_tpu.serve.rpc import QueryServer
+
+    config = config_from_args(args)
+    telemetry = obs.ensure(config)
+    agg = Aggregator(config, obs=telemetry).start()
+    engine = QueryEngine(
+        agg.mirror, obs=telemetry, batch_max=config.query_batch_max,
+        staleness_ceiling_s=config.read_staleness_ceiling_s or None)
+    server = QueryServer(engine, port=0 if config.serve_port < 0
+                         else config.serve_port).start()
+    if telemetry is not None and telemetry._server is not None:
+        from attendance_tpu.serve import http as serve_http
+        serve_http.attach(telemetry._server, engine)
+
+    def write_stats() -> None:
+        if not args.stats_json:
+            return
+        doc = agg.stats()
+        doc["serve_address"] = server.address
+        tmp = args.stats_json + ".tmp"
+        with open(tmp, "w") as fh:
+            _json.dump(doc, fh)
+        os.replace(tmp, args.stats_json)  # readers never see a torn file
+
+    print(f"federation aggregator folding {config.fed_gossip_topic!r} "
+          f"({agg.shard_map.num_shards} shard(s)), serving on "
+          f"{server.address}", flush=True)
+    try:
+        deadline = (_time.time() + args.serve_seconds
+                    if args.serve_seconds is not None else None)
+        while deadline is None or _time.time() < deadline:
+            _time.sleep(min(args.stats_every_s,
+                            max(0.05, deadline - _time.time())
+                            if deadline is not None else
+                            args.stats_every_s))
+            write_stats()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        try:
+            agg.stop()
+            write_stats()
+        finally:
+            server.stop()
+    _json.dump(agg.stats(), sys.stdout)
+    print(flush=True)
+
+
 def cmd_telemetry(args) -> None:
     """Pretty-print a telemetry artifact: a flight-recorder JSON dump
     (``kill -USR1`` / crash / --flight-path), a Prometheus exposition
@@ -434,6 +502,7 @@ def cmd_doctor(args) -> None:
             lane_skew_ceiling=args.lane_skew_ceiling,
             query_p99_ceiling=args.query_p99_ceiling,
             staleness_ceiling=args.staleness_ceiling,
+            merge_lag_ceiling=args.merge_lag_ceiling,
             quarantine_dir=args.quarantine)
     except FileNotFoundError as e:
         logger.error("no such artifact: %s", e)
@@ -556,6 +625,25 @@ def main(argv=None) -> None:
                        "until interrupted)")
     p_srv.set_defaults(fn=cmd_serve)
 
+    p_fed = sub.add_parser(
+        "federate", help="federation aggregator: fold the fence-"
+        "gossip merge-frame stream (Bloom-OR / HLL-max CRDT joins) "
+        "into one global view, fail over dead peers' shards, and "
+        "serve federated BF.EXISTS/PFCOUNT/occupancy answers on "
+        "--serve-port (+ /query/* JSON routes on --metrics-port)")
+    add_flags(p_fed)
+    p_fed.add_argument("--serve-seconds", type=float, default=None,
+                       help="exit after this long (default: serve "
+                       "until interrupted)")
+    p_fed.add_argument("--stats-json", default="",
+                       help="atomically publish the aggregator's live "
+                       "state (worker ledgers, shard map, fold "
+                       "counters) to this JSON file every "
+                       "--stats-every-s")
+    p_fed.add_argument("--stats-every-s", type=float, default=0.5,
+                       help="cadence of --stats-json rewrites")
+    p_fed.set_defaults(fn=cmd_federate)
+
     p_tel = sub.add_parser(
         "telemetry", help="pretty-print a flight-recorder dump, a "
         "--metrics-prom exposition file, or a --trace-out span trace "
@@ -602,6 +690,11 @@ def main(argv=None) -> None:
                        help="gate attendance_read_staleness_seconds "
                        "(the published read epoch's age at the last "
                        "scrape); omitted = informational row")
+    p_doc.add_argument("--merge-lag-ceiling", type=float, default=None,
+                       help="gate the federation merge-lag p99 "
+                       "(fence -> folded-into-global-view seconds) "
+                       "recovered from the prom histogram; omitted = "
+                       "informational row")
     p_doc.add_argument("--quarantine", default="",
                        help="list this on-disk dead-letter quarantine "
                        "in the verdict table")

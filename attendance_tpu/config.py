@@ -247,6 +247,26 @@ class Config:
     # barrier cadence when serving from a live pipeline, barrier +
     # refresh cadence from a chain reader).
     read_staleness_ceiling_s: float = 0.0
+    # Federated multi-host scale-out (attendance_tpu/federation):
+    # fed_worker names this ingest worker ("" = federation off). A
+    # federated worker owns hash shard fed_shard of fed_shards and, on
+    # every snapshot fence, gossips its dirty-bank delta (and full
+    # frames at preload/restore/base fences) as versioned merge frames
+    # onto fed_gossip_topic — Bloom-OR / HLL-register-max CRDT
+    # replication an aggregator (`federate` verb) folds into one
+    # queryable global view. fed_gossip_broker points gossip at a
+    # dedicated socket broker address ("" = ride this pipeline's own
+    # transport); fed_heartbeat_s keeps liveness observable between
+    # fences, and a peer silent past fed_dead_after_s is declared dead
+    # (shard orphaned at a bumped map version, durable chain recovered
+    # by the aggregator).
+    fed_worker: str = ""
+    fed_shard: int = 0
+    fed_shards: int = 1
+    fed_gossip_topic: str = "attendance-fed-gossip"
+    fed_gossip_broker: str = ""
+    fed_heartbeat_s: float = 2.0
+    fed_dead_after_s: float = 10.0
     # Total retry budget for one logical broker RPC over the socket
     # transport: transient failures reconnect + retry with jittered
     # exponential backoff inside this window, then surface ONE
@@ -317,6 +337,20 @@ class Config:
             ChaosSpec.parse(self.chaos)
         if self.retry_budget_s <= 0:
             raise ValueError("retry_budget_s must be positive")
+        if self.fed_shards < 1:
+            raise ValueError("fed_shards must be >= 1")
+        if not (0 <= self.fed_shard < self.fed_shards):
+            raise ValueError(
+                f"fed_shard {self.fed_shard} out of range "
+                f"[0, {self.fed_shards})")
+        if self.fed_heartbeat_s < 0:
+            raise ValueError(
+                "fed_heartbeat_s must be >= 0 (0 = no heartbeats)")
+        if self.fed_dead_after_s <= 0:
+            raise ValueError("fed_dead_after_s must be positive")
+        if self.fed_worker and not self.fed_gossip_topic:
+            raise ValueError(
+                "a federated worker needs a fed_gossip_topic")
         if not (-1 <= self.serve_port <= 65535):
             raise ValueError(
                 f"serve_port out of range: {self.serve_port} "
@@ -451,6 +485,27 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    default=d.read_staleness_ceiling_s,
                    help="SLO ceiling on the published read epoch's "
                    "age (0 = no objective)")
+    p.add_argument("--fed-worker", default=d.fed_worker,
+                   help="federated worker id; empty = federation off "
+                   "(attendance_tpu/federation)")
+    p.add_argument("--fed-shard", type=int, default=d.fed_shard,
+                   help="hash shard of the key space this worker owns")
+    p.add_argument("--fed-shards", type=int, default=d.fed_shards,
+                   help="total shards in the federation")
+    p.add_argument("--fed-gossip-topic", default=d.fed_gossip_topic,
+                   help="broker topic carrying the fence-gossip merge "
+                   "frames")
+    p.add_argument("--fed-gossip-broker", default=d.fed_gossip_broker,
+                   help="socket broker HOST:PORT for gossip (empty = "
+                   "ride the configured transport)")
+    p.add_argument("--fed-heartbeat-s", type=float,
+                   default=d.fed_heartbeat_s,
+                   help="gossip heartbeat cadence between fences "
+                   "(0 = none)")
+    p.add_argument("--fed-dead-after-s", type=float,
+                   default=d.fed_dead_after_s,
+                   help="silence budget before the aggregator "
+                   "declares a peer dead and recovers its shard")
     p.add_argument("--retry-budget-s", type=float,
                    default=d.retry_budget_s,
                    help="total reconnect+retry window per broker RPC "
@@ -543,6 +598,13 @@ def config_from_args(args: argparse.Namespace) -> Config:
         quarantine_dir=args.quarantine_dir,
         chaos=args.chaos,
         chaos_seed=args.chaos_seed,
+        fed_worker=args.fed_worker,
+        fed_shard=args.fed_shard,
+        fed_shards=args.fed_shards,
+        fed_gossip_topic=args.fed_gossip_topic,
+        fed_gossip_broker=args.fed_gossip_broker,
+        fed_heartbeat_s=args.fed_heartbeat_s,
+        fed_dead_after_s=args.fed_dead_after_s,
         retry_budget_s=args.retry_budget_s,
         serve_port=args.serve_port,
         query_batch_max=args.query_batch_max,

@@ -1,0 +1,34 @@
+"""Federated multi-host scale-out: sketch-native CRDT replication.
+
+ROADMAP item 4. K independent ingest workers each own a hash shard of
+the key space (``federation.shard``) and run the existing fused
+pipeline unchanged; their sketch state replicates through **fence
+gossip** (``federation.gossip``): every snapshot fence publishes the
+dirty-bank delta the PR 4 capture already made durable as a versioned
+merge frame (``federation.frames``), and an aggregator folds the
+stream into one global view (``federation.merge``) published as read
+epochs — so the PR 7 query plane serves federated BF.EXISTS / PFCOUNT
+/ occupancy answers with no new read machinery.
+
+Why this is lock-free and convergent: Bloom filters join under bitwise
+OR and HLL banks under register max — state-based CRDTs (commutative,
+associative, idempotent), the same property Redis exploits for PFMERGE
+(PAPER.md §0.2) — so frame order, duplication, and replay are all
+harmless; only cumulative counters need ordering, and those fold
+newest-(incarnation, seq)-wins per worker. Failover: a peer silent
+past the budget is declared dead, its shard is orphaned in the
+versioned shard map, the aggregator immediately recovers its durable
+base+delta chain through ``fast_path.read_chain_state``, and a
+takeover worker (same id, higher incarnation) restores the same chain,
+replays the quarantine, and drains the broker-requeued remainder
+(``federation.worker --takeover``).
+"""
+
+from attendance_tpu.federation.frames import (  # noqa: F401
+    FRAME_VERSION, MergeFrame, decode_frame, encode_frame)
+from attendance_tpu.federation.gossip import (  # noqa: F401
+    Aggregator, DEFAULT_GOSSIP_TOPIC, FenceGossip, GOSSIP_SUBSCRIPTION)
+from attendance_tpu.federation.merge import (  # noqa: F401
+    GeometryMismatch, MergedView)
+from attendance_tpu.federation.shard import (  # noqa: F401
+    ShardMap, shard_of_keys, shard_topic)

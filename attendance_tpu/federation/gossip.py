@@ -1,0 +1,534 @@
+"""Fence gossip: workers publish merge frames, an aggregator folds them.
+
+Worker side (:class:`FenceGossip`): hooked onto the fused pipeline's
+snapshot fences — every durable delta barrier publishes the SAME
+dirty-bank capture the PR 4 writer just made durable (zero extra device
+traffic), every full base/preload/restore publishes a full frame
+(packed Bloom words + all banks), and a background thread heartbeats
+between fences so liveness stays observable through ingest gaps. Gossip
+rides the configured broker transport (its own socket connection when
+``--fed-gossip-broker`` names one), so the PR 5 retry/reconnect/chaos
+seams apply at the ``fed.gossip`` site. A gossip publish failure NEVER
+fails the snapshot barrier — durability is local; the publisher marks
+itself ``full_due`` and upgrades its next successful publish to a full
+frame, so a dropped delta costs freshness, not convergence.
+
+Aggregator side (:class:`Aggregator`): one consumer loop decoding
+frames into a :class:`federation.merge.MergedView` and republishing the
+merged state as read epochs through ``serve.mirror.ReadMirror`` — the
+PR 7 query plane then serves federated BF.EXISTS / PFCOUNT / occupancy
+answers with no new read machinery. Liveness: a peer silent past
+``--fed-dead-after-s`` is declared down (``attendance_fed_peer_up`` ->
+0), its shards are orphaned in the versioned shard map (version bump =
+the stale-frame fence), and its durable state is recovered immediately
+by replaying its on-disk base+delta chain through
+``fast_path.read_chain_state`` — so the global view never regresses
+while a takeover worker (same worker id, higher incarnation, restored
+from the same chain) spins up and re-claims the shard.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+import numpy as np
+
+from attendance_tpu.federation.frames import (
+    MergeFrame, decode_frame, encode_frame)
+from attendance_tpu.federation.merge import GeometryMismatch, MergedView
+from attendance_tpu.federation.shard import ShardMap
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_GOSSIP_TOPIC = "attendance-fed-gossip"
+GOSSIP_SUBSCRIPTION = "fed-aggregator"
+
+
+def _gossip_client(config, fallback_client=None):
+    """(client, owned): a dedicated SocketClient when
+    ``fed_gossip_broker`` names an address, else the caller's own
+    transport client (gossip and data plane share a broker)."""
+    addr = getattr(config, "fed_gossip_broker", "")
+    if addr:
+        from attendance_tpu import chaos
+        from attendance_tpu.transport.socket_broker import SocketClient
+        return SocketClient(addr, chaos=chaos.get()), True
+    if fallback_client is not None:
+        return fallback_client, False
+    from attendance_tpu.transport import make_client
+    return make_client(config), True
+
+
+def claim_incarnation(snapshot_dir: str) -> float:
+    """Mint a per-worker incarnation strictly newer than any prior
+    owner of the same chain dir.
+
+    Wall clock alone breaks failover across hosts: a takeover minted on
+    a machine whose clock trails the dead peer's would gossip a LOWER
+    incarnation and every one of its frames would fold as stale
+    (counters frozen, peer never revived). Workers that share a chain
+    dir — the takeover contract — instead bump a durable high-water
+    mark stored beside the chain, so the successor is newer by
+    construction; the clock only seeds the first claim and keeps the
+    mark roughly human-readable."""
+    now = time.time()
+    if not snapshot_dir:
+        return now
+    path = Path(snapshot_dir) / "INCARNATION"
+    prev = -1.0
+    try:
+        prev = float(path.read_text().strip() or -1.0)
+    except (OSError, ValueError):
+        pass
+    inc = max(now, prev + 1.0)
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(repr(inc))
+        tmp.replace(path)
+    except OSError:
+        logger.warning("could not persist incarnation mark under %s; "
+                       "takeover ordering falls back to wall clock",
+                       snapshot_dir, exc_info=True)
+    return inc
+
+
+class FenceGossip:
+    """Worker-side merge-frame publisher (one per fused pipeline)."""
+
+    def __init__(self, config, *, client=None, m_bits: int = 0,
+                 k: int = 0, obs=None):
+        self.worker = getattr(config, "fed_worker", "") or "w0"
+        self.shard = int(getattr(config, "fed_shard", 0))
+        self.topic = (getattr(config, "fed_gossip_topic", "")
+                      or DEFAULT_GOSSIP_TOPIC)
+        self.precision = getattr(config, "hll_precision", 14)
+        self.m_bits, self.k = m_bits, k
+        self.snapshot_dir = getattr(config, "snapshot_dir", "")
+        self.incarnation = claim_incarnation(self.snapshot_dir)
+        self.full_due = False  # a failed publish owes a full frame
+        self._seq = itertools.count()
+        self._client, self._owns_client = _gossip_client(config, client)
+        self._producer = self._client.create_producer(self.topic)
+        self._lock = threading.Lock()  # writer thread + heartbeat
+        self._closed = False
+        self._hb_s = float(getattr(config, "fed_heartbeat_s", 2.0))
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._last_events = 0
+        self._c_frames = self._c_failures = None
+        if obs is not None:
+            self._c_frames = {
+                kind: obs.registry.counter(
+                    "attendance_fed_gossip_frames_total",
+                    help="Merge frames published to the gossip topic",
+                    kind=kind, worker=self.worker)
+                for kind in ("full", "delta", "heartbeat")}
+            self._c_failures = obs.registry.counter(
+                "attendance_fed_gossip_failures_total",
+                help="Gossip publishes that failed (the next "
+                "successful publish upgrades to a full frame)",
+                worker=self.worker)
+
+    def start_heartbeat(self) -> "FenceGossip":
+        if self._hb_s > 0 and self._hb_thread is None:
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, name="fed-heartbeat", daemon=True)
+            self._hb_thread.start()
+        return self
+
+    def _hb_loop(self) -> None:
+        while not self._hb_stop.wait(self._hb_s):
+            self.heartbeat()
+
+    def _send(self, kind: str, encode) -> bool:
+        """``encode`` builds the payload (allocating its seq) UNDER the
+        send lock: seq order must equal wire order, or a heartbeat
+        racing a fence would make the aggregator call the real delta
+        stale."""
+        try:
+            with self._lock:
+                if self._closed:
+                    return False
+                self._producer.send(encode())
+        except Exception:
+            if self._c_failures is not None:
+                self._c_failures.inc()
+            self.full_due = kind != "heartbeat" or self.full_due
+            logger.warning("fed gossip %s publish failed (deferred to "
+                           "next fence)", kind, exc_info=True)
+            return False
+        if self._c_frames is not None:
+            self._c_frames[kind].inc()
+        return True
+
+    def _encode(self, kind: str, events: int, *, bank_of=None,
+                roster_size: int = 0, num_banks: int = 0,
+                arrays=None) -> bytes:
+        return encode_frame(
+            worker=self.worker, kind=kind,
+            incarnation=self.incarnation, seq=next(self._seq),
+            shard=self.shard, fence_ts=time.time(),
+            events=int(events),
+            bank_of=bank_of, m_bits=self.m_bits, k=self.k,
+            precision=self.precision, num_banks=num_banks,
+            roster_size=roster_size, snapshot_dir=self.snapshot_dir,
+            arrays=arrays)
+
+    def publish_full(self, bloom_words, regs, counts,
+                     bank_of: Dict[int, int], events: int,
+                     roster_size: int = 0) -> bool:
+        arrays = {"regs": np.asarray(regs, np.uint8),
+                  "counts": np.asarray(counts, np.uint32)}
+        if bloom_words is not None:
+            arrays["bloom"] = np.asarray(bloom_words, np.uint32)
+        self._last_events = int(events)
+        ok = self._send("full", lambda: self._encode(
+            "full", events, bank_of=bank_of, roster_size=roster_size,
+            num_banks=arrays["regs"].shape[0], arrays=arrays))
+        if ok:
+            self.full_due = False
+        return ok
+
+    def publish_delta(self, banks, rows, counts,
+                      bank_of: Dict[int, int], events: int,
+                      num_banks: int, roster_size: int = 0) -> bool:
+        self._last_events = int(events)
+        return self._send("delta", lambda: self._encode(
+            "delta", events, bank_of=bank_of, roster_size=roster_size,
+            num_banks=num_banks, arrays={
+                "bank_idx": np.asarray(banks, np.int32),
+                "rows": np.asarray(rows, np.uint8),
+                "counts": np.asarray(counts, np.uint32)}))
+
+    def heartbeat(self) -> bool:
+        return self._send("heartbeat", lambda: self._encode(
+            "heartbeat", self._last_events))
+
+    def close(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._producer.close()
+            finally:
+                if self._owns_client:
+                    try:
+                        self._client.close()
+                    except Exception:
+                        pass
+
+
+class Aggregator:
+    """Fold the gossip stream into a queryable global view."""
+
+    _TRACE_ROLE = "fed-aggregator"
+
+    def __init__(self, config=None, *, client=None,
+                 topic: Optional[str] = None,
+                 num_shards: Optional[int] = None,
+                 dead_after_s: Optional[float] = None,
+                 precision: Optional[int] = None, obs=None):
+        from attendance_tpu.serve.mirror import ReadMirror
+
+        cfg = config
+        self.topic = topic or (getattr(cfg, "fed_gossip_topic", "")
+                               or DEFAULT_GOSSIP_TOPIC)
+        self.dead_after_s = (dead_after_s if dead_after_s is not None
+                             else float(getattr(cfg, "fed_dead_after_s",
+                                                10.0)))
+        self.view = MergedView(precision if precision is not None
+                               else getattr(cfg, "hll_precision", 14))
+        self.shard_map = ShardMap(
+            num_shards if num_shards is not None
+            else max(1, int(getattr(cfg, "fed_shards", 1))))
+        self.mirror = ReadMirror()
+        self._client, self._owns_client = _gossip_client(cfg, client)
+        self.consumer = self._client.subscribe(self.topic,
+                                               GOSSIP_SUBSCRIPTION)
+        self._down: set = set()
+        self.recovered_chains: Dict[str, int] = {}
+        self.geometry_rejects = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._obs = obs
+        self._tracer = obs.tracer if obs is not None else None
+        self._h_lag = self._c_deltas = self._c_stale = None
+        self._c_takeovers = self._g_peers = self._c_geom = None
+        if obs is not None:
+            self._h_lag = obs.registry.histogram(
+                "attendance_fed_merge_lag_seconds",
+                help="Fence -> folded-into-global-view latency per "
+                "merge frame", scale=1e3)
+            self._c_deltas = obs.registry.counter(
+                "attendance_fed_merged_deltas_total",
+                help="State-carrying merge frames folded into the "
+                "global view")
+            self._c_stale = obs.registry.counter(
+                "attendance_fed_stale_frames_total",
+                help="Frames from a superseded incarnation/sequence "
+                "(sketch folded idempotently, counters ignored)")
+            self._c_takeovers = obs.registry.counter(
+                "attendance_fed_takeovers_total",
+                help="Dead-peer shard reassignments (failover events)")
+            self._c_geom = obs.registry.counter(
+                "attendance_fed_geometry_rejects_total",
+                help="Gossip frames rejected for incompatible sketch "
+                "geometry (a misconfigured peer; doctor fails on any)")
+            obs.registry.gauge(
+                "attendance_fed_map_version",
+                help="Version of the federation shard map (bumps on "
+                "every reassignment)").set_function(
+                    lambda: float(self.shard_map.version))
+            self._g_peers = {}
+            self.mirror.register_gauges(obs)
+
+    # -- folding -------------------------------------------------------------
+    def _peer_gauge(self, worker: str):
+        if self._obs is None:
+            return None
+        g = self._g_peers.get(worker)
+        if g is None:
+            g = self._g_peers[worker] = self._obs.registry.gauge(
+                "attendance_fed_peer_up",
+                help="1 while the peer's gossip is fresh, 0 once it "
+                "is declared dead", peer=worker)
+        return g
+
+    def fold_frame(self, frame: MergeFrame,
+                   now: Optional[float] = None) -> Dict:
+        t0 = time.perf_counter()
+        info = self.view.fold(frame, now=now)
+        worker = frame.worker
+        ledger = self.view.workers[worker]
+        # The aggregator's own chain-recovery fold (header marker
+        # "recovered") re-asserts a dead peer's STATE, never its
+        # liveness or its shard claim: the shard stays orphaned until
+        # a successor gossips for itself.
+        synthetic = bool(frame.header.get("recovered"))
+        if worker in self._down and not info["stale"] and not synthetic:
+            # A takeover worker reuses the dead peer's worker id at a
+            # higher incarnation: fresh gossip marks the peer healthy.
+            self._down.discard(worker)
+            logger.info("fed peer %s is back up (incarnation %.3f)",
+                        worker, ledger.incarnation)
+        if ledger.shard >= 0 and not info["stale"] \
+                and worker not in self._down:
+            self.shard_map.claim(ledger.shard, worker)
+        g = self._peer_gauge(worker)
+        if g is not None:
+            g.set(0.0 if worker in self._down else 1.0)
+        if info["lag_s"] is not None:
+            if self._h_lag is not None:
+                self._h_lag.observe(info["lag_s"])
+                self._c_deltas.inc()
+            if self._tracer is not None:
+                self._tracer.add_span(
+                    "fed_merge", t0, time.perf_counter(),
+                    trace_id=self._tracer.new_id(),
+                    role=self._TRACE_ROLE,
+                    args={"worker": worker, "kind": frame.kind,
+                          "lag_s": round(info["lag_s"], 6)})
+        if info["stale"] and self._c_stale is not None:
+            self._c_stale.inc()
+        return info
+
+    def publish_epoch(self) -> None:
+        """Republish the merged view as the next federated read epoch
+        (the query plane pins these)."""
+        if self.view.params is None and not self.view.bank_of:
+            return  # nothing merged yet
+        self.mirror.publish(**self.view.epoch_fields())
+
+    def poll(self, timeout_ms: int = 200) -> int:
+        """Drain one receive round; returns state frames folded (and
+        publishes a fresh epoch when > 0)."""
+        from attendance_tpu.transport.memory_broker import (
+            ReceiveTimeout)
+
+        try:
+            msgs = self.consumer.receive_many(64,
+                                              timeout_millis=timeout_ms)
+        except ReceiveTimeout:
+            return 0
+        folded = 0
+        for msg in msgs:
+            try:
+                frame = decode_frame(bytes(msg.data()))
+            except Exception:
+                logger.exception("undecodable gossip frame dropped")
+                self.consumer.acknowledge(msg)
+                continue
+            try:
+                info = self.fold_frame(frame)
+                folded += info["lag_s"] is not None
+            except GeometryMismatch as exc:
+                # Loud, attributed, and gated (doctor fails on the
+                # counter) — but bounded: one misconfigured peer must
+                # not be able to kill the whole federation's serving.
+                self.geometry_rejects += 1
+                if self._c_geom is not None:
+                    self._c_geom.inc()
+                logger.error("gossip frame from %s REJECTED: %s",
+                             frame.worker, exc)
+            except Exception:
+                logger.exception("gossip frame fold failed; dropped")
+            self.consumer.acknowledge(msg)
+        if folded:
+            self.publish_epoch()
+        return folded
+
+    # -- liveness + failover -------------------------------------------------
+    def check_liveness(self, now: Optional[float] = None) -> list:
+        """Declare peers silent past the budget dead; returns newly
+        dead worker ids (each already reassigned + chain-recovered)."""
+        now = time.time() if now is None else now
+        newly_dead = []
+        for worker, ledger in self.view.workers.items():
+            if worker in self._down:
+                continue
+            if now - ledger.last_seen > self.dead_after_s:
+                newly_dead.append(worker)
+        for worker in newly_dead:
+            self._on_dead(worker)
+        return newly_dead
+
+    def _on_dead(self, worker: str) -> None:
+        self._down.add(worker)
+        g = self._peer_gauge(worker)
+        if g is not None:
+            g.set(0.0)
+        moved = self.shard_map.reassign(worker, None)
+        if self._c_takeovers is not None:
+            self._c_takeovers.inc()
+        logger.warning(
+            "fed peer %s declared dead (silent > %.1fs): shards %s "
+            "orphaned at map version %d, recovering its chain",
+            worker, self.dead_after_s, moved, self.shard_map.version)
+        ledger = self.view.workers[worker]
+        if ledger.snapshot_dir:
+            try:
+                self.recover_chain(worker, ledger.snapshot_dir)
+                self.publish_epoch()
+            except FileNotFoundError:
+                logger.warning("dead peer %s advertised snapshot dir "
+                               "%s but no chain exists there", worker,
+                               ledger.snapshot_dir)
+            except Exception:
+                logger.exception("chain recovery for dead peer %s "
+                                 "failed", worker)
+
+    def recover_chain(self, worker: str, snapshot_dir) -> int:
+        """Fold the dead peer's durable base+delta chain into the view
+        (the same merge-on-read loader restore and the chain readers
+        use), so everything the peer made durable is served even
+        before a takeover worker exists. Idempotent: the takeover
+        worker's own full frames re-assert the same state. Returns the
+        recovered cumulative event count."""
+        from attendance_tpu.pipeline.fast_path import read_chain_state
+
+        state = read_chain_state(Path(snapshot_dir))
+        ledger = self.view.workers[worker]
+        man = state["manifest"]
+        frame = MergeFrame(
+            header=dict(
+                worker=worker, kind="full",
+                incarnation=ledger.incarnation, seq=ledger.seq + 1,
+                shard=ledger.shard,
+                # Recovery folds state that was durable BEFORE the
+                # death was noticed; stamping the fold time keeps the
+                # merge-lag histogram describing gossip latency, not
+                # how long the peer had been quietly durable.
+                fence_ts=time.time(),
+                events=int(state["events"]),
+                roster_size=ledger.roster_size,
+                m_bits=int(man["m_bits"]), k=int(man["k"]),
+                precision=int(man["precision"]),
+                num_banks=state["regs"].shape[0],
+                snapshot_dir=str(snapshot_dir), recovered=True,
+                bank_of={int(d): int(b)
+                         for d, b in state["bank_of"].items()}),
+            arrays=dict(
+                bloom=np.asarray(state["bits"], np.uint32),
+                regs=np.asarray(state["regs"], np.uint8),
+                counts=np.asarray(state["counts"], np.uint32)))
+        self.fold_frame(frame)
+        self.recovered_chains[worker] = int(state["events"])
+        logger.info("recovered %d durable events from %s's chain at "
+                    "%s", int(state["events"]), worker, snapshot_dir)
+        return int(state["events"])
+
+    # -- loop ----------------------------------------------------------------
+    def start(self) -> "Aggregator":
+        self._thread = threading.Thread(
+            target=self._loop, name="fed-aggregator", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll(timeout_ms=200)
+                self.check_liveness()
+            except Exception:
+                if self._stop.is_set():
+                    return
+                logger.exception("aggregator poll failed (retrying)")
+                time.sleep(0.2)
+
+    def pause(self) -> None:
+        """Stop the background fold loop but keep the consumer open —
+        the caller takes over polling (drivers drain the gossip tail
+        synchronously before asserting against the view)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            self.consumer.close()
+        except Exception:
+            pass
+        if self._owns_client:
+            try:
+                self._client.close()
+            except Exception:
+                pass
+
+    def stats(self) -> Dict:
+        # Callers poll this from other threads while the fold loop
+        # mutates the ledgers; dict() copies are C-level (atomic under
+        # the GIL), so iterate the copies, never the live dicts — a
+        # Python-level comprehension over view.workers can raise
+        # "dictionary changed size during iteration" mid-fold.
+        workers = dict(self.view.workers)
+        return {
+            "events": sum(w.events for w in workers.values()),
+            "workers": {
+                w: {"events": led.events, "shard": led.shard,
+                    "up": w not in self._down,
+                    "incarnation": led.incarnation}
+                for w, led in workers.items()},
+            "shard_map": self.shard_map.to_dict(),
+            "banks": len(self.view.bank_of),
+            "folded_deltas": self.view.folded_deltas,
+            "folded_fulls": self.view.folded_fulls,
+            "stale_frames": self.view.stale_frames,
+            "geometry_rejects": self.geometry_rejects,
+            "recovered_chains": dict(self.recovered_chains),
+        }

@@ -1,0 +1,245 @@
+"""The federation merge core: fold merge frames into one global view.
+
+Sketches are state-based CRDTs — a Bloom filter joins under bitwise OR
+and an HLL bank under register max (``models.bloom.bloom_or_words`` /
+``models.hll.hll_merge`` and their numpy twins), both commutative,
+associative, and idempotent — so the global view converges regardless
+of frame order, duplication, or replay, with no locks and no consensus.
+The one real reconciliation problem is NAMING: each worker assigns HLL
+bank rows to lecture days in its own arrival order, so bank indices
+mean different days on different workers. :class:`MergedView` therefore
+keys the global register array by DAY — every folded row is routed
+through the frame's own ``bank_of`` map into a global day->bank
+assignment — which also gives bank-growth reconciliation for free
+(global banks grow by doubling as new days appear, exactly like the
+per-worker arrays).
+
+Cumulative counters (events processed, valid/invalid totals, roster
+size) are NOT idempotent under re-add, so they fold
+newest-(incarnation, seq)-wins per worker id and aggregate as a sum
+over workers: a replayed or stale frame can never double-count, and a
+takeover worker (same worker id, higher incarnation, counter seeded
+from the dead peer's restored chain) supersedes its predecessor
+monotonically.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from attendance_tpu.models.bloom import BloomParams, bloom_or_words_np
+from attendance_tpu.models.fused import decode_counts
+from attendance_tpu.federation.frames import MergeFrame
+
+
+class GeometryMismatch(ValueError):
+    """Frames describe incompatible sketch geometry (capacity /
+    error-rate / layout / precision differ across the federation)."""
+
+
+class _WorkerLedger:
+    """Per-worker-id cumulative-counter state, newest-incarnation-wins."""
+
+    __slots__ = ("incarnation", "seq", "events", "valid", "invalid",
+                 "roster_size", "shard", "snapshot_dir", "last_seen",
+                 "last_fence_ts")
+
+    def __init__(self):
+        self.incarnation = -1.0
+        self.seq = -1
+        self.events = 0
+        self.valid = 0
+        self.invalid = 0
+        self.roster_size = 0
+        self.shard = -1
+        self.snapshot_dir = ""
+        self.last_seen = 0.0
+        self.last_fence_ts = 0.0
+
+
+class MergedView:
+    """The aggregator's global sketch state, built by folding frames.
+
+    Thread-compat note: fold() is called from one consumer loop; reads
+    for publication go through :meth:`epoch_fields`, which snapshots
+    under the caller's control (the aggregator publishes immutable
+    epochs through serve.mirror, so readers never see this object).
+    """
+
+    def __init__(self, precision: int = 14):
+        self.precision = precision
+        self.m = 1 << precision
+        self.params: Optional[BloomParams] = None
+        self.bloom_words: Optional[np.ndarray] = None
+        self.bank_of: Dict[int, int] = {}  # day -> global bank
+        self.regs = np.zeros((8, self.m), np.uint8)
+        self.workers: Dict[str, _WorkerLedger] = {}
+        self.folded_deltas = 0
+        self.folded_fulls = 0
+        self.stale_frames = 0
+
+    # -- bank routing --------------------------------------------------------
+    def _global_bank(self, day: int) -> int:
+        bank = self.bank_of.get(day)
+        if bank is not None:
+            return bank
+        bank = len(self.bank_of)
+        if bank >= self.regs.shape[0]:
+            grown = np.zeros((self.regs.shape[0] * 2, self.m), np.uint8)
+            grown[:self.regs.shape[0]] = self.regs
+            self.regs = grown
+        self.bank_of[day] = bank
+        return bank
+
+    def _check_geometry(self, frame: MergeFrame) -> None:
+        if int(frame.precision) != self.precision:
+            raise GeometryMismatch(
+                f"worker {frame.worker} gossips precision "
+                f"{frame.precision}, aggregator runs {self.precision}")
+        if frame.m_bits and self.params is not None and \
+                int(frame.m_bits) != self.params.m_bits:
+            raise GeometryMismatch(
+                f"worker {frame.worker} gossips a {frame.m_bits}-bit "
+                f"filter, federation runs {self.params.m_bits} bits — "
+                "Bloom capacity/error-rate/layout must match")
+        if frame.k and self.params is not None and \
+                int(frame.k) != self.params.k:
+            # Same m_bits with a different probe count still breaks
+            # the no-false-negative contract: the reader probes k
+            # positions the writer never set.
+            raise GeometryMismatch(
+                f"worker {frame.worker} gossips k={frame.k} hash "
+                f"probes, federation runs k={self.params.k}")
+
+    # -- folding -------------------------------------------------------------
+    def fold(self, frame: MergeFrame,
+             now: Optional[float] = None) -> Dict:
+        """Fold one decoded frame; returns
+        ``{"stale": bool, "lag_s": float | None}`` (lag only for
+        state-carrying frames — the fence->fold latency)."""
+        now = time.time() if now is None else now
+        self._check_geometry(frame)
+        w = self.workers.setdefault(frame.worker, _WorkerLedger())
+        key = (float(frame.incarnation), int(frame.seq))
+        stale = key <= (w.incarnation, w.seq)
+        if not stale:
+            # Liveness rides only CURRENT-incarnation traffic: a
+            # superseded zombie's heartbeats must not keep the ledger
+            # fresh, or the death of its takeover successor (same
+            # worker id) could never be detected.
+            w.last_seen = now
+            w.incarnation, w.seq = key
+            w.shard = int(frame.shard)
+            if frame.header.get("snapshot_dir"):
+                w.snapshot_dir = frame.header["snapshot_dir"]
+            # Cumulative counters are monotone per worker; max() keeps
+            # them monotone even if a frame from a fresh incarnation
+            # briefly trails the chain-restored totals.
+            w.events = max(w.events, int(frame.events))
+            w.roster_size = max(w.roster_size, int(frame.roster_size))
+            if "counts" in frame.arrays:
+                valid, invalid = decode_counts(frame.arrays["counts"])
+                w.valid = max(w.valid, valid)
+                w.invalid = max(w.invalid, invalid)
+        else:
+            self.stale_frames += 1
+        if frame.kind == "heartbeat":
+            return {"stale": stale, "lag_s": None}
+        # Sketch state folds EVEN FROM STALE FRAMES: OR/max are
+        # idempotent, so a late frame from a previous owner can only
+        # re-assert state the takeover already carries (and if the old
+        # owner saw events the chain missed, folding them here is the
+        # difference between "no loss" and "loss").
+        if "bloom" in frame.arrays:
+            words = np.asarray(frame.arrays["bloom"], np.uint32)
+            if self.params is None:
+                self.params = BloomParams(
+                    m_bits=int(frame.m_bits), k=int(frame.k),
+                    layout="blocked", capacity=0, error_rate=0.0)
+            if self.bloom_words is None:
+                self.bloom_words = words.copy()
+            else:
+                self.bloom_words = bloom_or_words_np(
+                    self.bloom_words, words)
+        inv = {b: d for d, b in frame.bank_of.items()}
+        if frame.kind == "full" and "regs" in frame.arrays:
+            rows = np.asarray(frame.arrays["regs"], np.uint8)
+            local_banks = np.arange(rows.shape[0])
+            self.folded_fulls += 1
+        elif frame.kind == "delta":
+            rows = np.asarray(frame.arrays.get(
+                "rows", np.zeros((0, self.m), np.uint8)), np.uint8)
+            local_banks = np.asarray(frame.arrays.get(
+                "bank_idx", np.zeros(0, np.int32)), np.int64)
+            self.folded_deltas += 1
+        else:
+            rows = np.zeros((0, self.m), np.uint8)
+            local_banks = np.zeros(0, np.int64)
+        if rows.shape[0]:
+            if rows.shape[1] != self.m:
+                raise GeometryMismatch(
+                    f"worker {frame.worker} gossips {rows.shape[1]} "
+                    f"registers/bank, aggregator expects {self.m}")
+            gbanks = []
+            keep = []
+            for i, lb in enumerate(np.asarray(local_banks).tolist()):
+                day = inv.get(int(lb))
+                if day is None:
+                    # A bank the worker's map does not name (registered
+                    # after the capture raced the map copy): skip — the
+                    # next fence names it.
+                    continue
+                gbanks.append(self._global_bank(int(day)))
+                keep.append(i)
+            if keep:
+                gb = np.asarray(gbanks, np.int64)
+                sub = rows[np.asarray(keep, np.int64)]
+                # Local banks are unique within a frame, so gb is
+                # unique: direct fancy-index max-merge is exact.
+                self.regs[gb] = np.maximum(self.regs[gb], sub)
+        return {"stale": stale,
+                "lag_s": max(0.0, now - float(frame.fence_ts))}
+
+    # -- aggregate reads -----------------------------------------------------
+    @property
+    def events(self) -> int:
+        return sum(w.events for w in self.workers.values())
+
+    @property
+    def roster_size(self) -> int:
+        return sum(w.roster_size for w in self.workers.values())
+
+    def counts_array(self) -> np.ndarray:
+        """Aggregate (valid, invalid) re-encoded as the two-lane
+        uint32[2, 2] the epoch/stats surfaces decode."""
+        valid = sum(w.valid for w in self.workers.values())
+        invalid = sum(w.invalid for w in self.workers.values())
+        out = np.zeros((2, 2), np.uint32)
+        out[0, 0] = valid & 0xFFFFFFFF
+        out[0, 1] = valid >> 32
+        out[1, 0] = invalid & 0xFFFFFFFF
+        out[1, 1] = invalid >> 32
+        return out
+
+    def epoch_fields(self) -> Dict:
+        """Everything serve.mirror.ReadMirror.publish needs for the
+        next federated read epoch."""
+        return dict(
+            regs=self.regs[:max(len(self.bank_of), 1)],
+            events=self.events,
+            bank_of=dict(self.bank_of),
+            params=self.params,
+            precision=self.precision,
+            bloom_words=self.bloom_words,
+            counts=self.counts_array(),
+            roster_size=self.roster_size,
+            source="federated")
+
+    def regs_by_day(self) -> Dict[int, np.ndarray]:
+        """{day: register row} — the oracle-comparison surface the
+        federation soak gates on."""
+        return {day: self.regs[bank].copy()
+                for day, bank in self.bank_of.items()}

@@ -296,6 +296,28 @@ def chunked_preload(preload_fn, bits, keys, chunk: int = PRELOAD_CHUNK):
     return bits
 
 
+def bloom_or_words(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Bloom union over packed words: bitwise OR (the BF.MERGE / shard
+    union collective). A Bloom filter is a state-based CRDT under OR —
+    commutative, associative, idempotent — which is what makes the
+    federation plane's replication lock-free and convergent."""
+    return a | b
+
+
+def bloom_or_words_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Numpy twin of :func:`bloom_or_words` (host-side merge core).
+    Filters must share geometry — OR-ing different word counts would
+    silently break the no-false-negative contract, so fail loudly."""
+    a = np.asarray(a, dtype=np.uint32)
+    b = np.asarray(b, dtype=np.uint32)
+    if a.shape != b.shape:
+        raise ValueError(
+            f"packed filter shapes differ ({a.shape} vs {b.shape}) — "
+            "capacity/error-rate/layout must match across the "
+            "federation")
+    return a | b
+
+
 def bloom_contains_words(words: jax.Array, keys: jax.Array,
                          params: BloomParams) -> jax.Array:
     """Membership test against a packed filter: bool[B].

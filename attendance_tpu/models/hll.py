@@ -209,6 +209,27 @@ def hll_merge(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.maximum(a, b)
 
 
+def hll_merge_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Numpy twin of :func:`hll_merge` with bank-growth reconciliation:
+    the shorter bank stack is treated as zero-extended to the longer
+    one (register 0 is the identity of max), so replicas that grew
+    their bank arrays at different times merge without ceremony. This
+    is the federation merge core's HLL half (state-based CRDT join:
+    commutative, associative, idempotent)."""
+    a = np.atleast_2d(np.asarray(a, dtype=np.uint8))
+    b = np.atleast_2d(np.asarray(b, dtype=np.uint8))
+    if a.shape[1] != b.shape[1]:
+        raise ValueError(
+            f"register widths differ ({a.shape[1]} vs {b.shape[1]}) — "
+            "HLL precisions are not convertible")
+    if a.shape[0] == b.shape[0]:
+        return np.maximum(a, b)
+    hi, lo = (a, b) if a.shape[0] > b.shape[0] else (b, a)
+    out = hi.copy()
+    np.maximum(out[:lo.shape[0]], lo, out=out[:lo.shape[0]])
+    return out
+
+
 def hll_histograms_np(rows: np.ndarray, precision: int = 14) -> np.ndarray:
     """Register-value histograms for a stack of HOST register rows:
     int64[num_rows, q+2] from uint8[num_rows, 2^p], in ONE bincount

@@ -477,7 +477,8 @@ def _prom_checks(text: str, fpr_ceiling: float,
                  max_reconnects: Optional[int] = None,
                  lane_skew_ceiling: Optional[float] = None,
                  query_p99_ceiling: Optional[float] = None,
-                 staleness_ceiling: Optional[float] = None
+                 staleness_ceiling: Optional[float] = None,
+                 merge_lag_ceiling: Optional[float] = None
                  ) -> List[List[str]]:
     from attendance_tpu.obs.exposition import parse_prom
 
@@ -595,6 +596,61 @@ def _prom_checks(text: str, fpr_ceiling: float,
                      f"<= {_fmt_value(hll_error_ceiling)}",
                      "PASS" if max(qerr) <= hll_error_ceiling
                      else "FAIL"])
+    # Federation plane: fence->fold merge lag (gated by
+    # --merge-lag-ceiling; informational without), peer liveness at
+    # the last scrape, and fold/staleness counters. Peers-down is an
+    # informational row, not a gate: a worker that exited cleanly
+    # after its final fence looks "down" to an aggregator that
+    # outlives it by the silence budget, which is the normal teardown
+    # order — the soak gates takeover by its own invariants instead.
+    fpairs = []
+    for name, labels, value in samples:
+        if name == "attendance_fed_merge_lag_seconds_bucket":
+            le = _parse_le(labels)
+            if le is not None:
+                try:
+                    fpairs.append((le, float(value)))
+                except ValueError:
+                    continue
+    has_lag = bool(fpairs) and max(c for _, c in fpairs) > 0
+    if has_lag and merge_lag_ceiling is None:
+        (p99,) = quantiles_from_cumulative(fpairs, (0.99,))
+        rows.append(["fed merge lag p99", _fmt_value(p99), "-",
+                     "info"])
+    elif merge_lag_ceiling is not None:
+        # The ceiling is only ever set for runs that gossiped: an
+        # absent/empty histogram means the aggregator never folded a
+        # fence, so the gate must FAIL loudly, not pass vacuously.
+        p99 = (quantiles_from_cumulative(fpairs, (0.99,))[0]
+               if has_lag else None)
+        rows.append(["fed merge lag p99", _fmt_value(p99),
+                     f"<= {_fmt_value(merge_lag_ceiling)}",
+                     "FAIL" if p99 is None or p99 > merge_lag_ceiling
+                     else "PASS"])
+    peers = [(labels, float(v)) for name, labels, v in samples
+             if name == "attendance_fed_peer_up"]
+    if peers:
+        up = sum(1 for _, v in peers if v >= 1.0)
+        rows.append(["fed peers up at last scrape",
+                     f"{up}/{len(peers)}", "-", "info"])
+    merged = _vals("attendance_fed_merged_deltas_total")
+    if merged:
+        rows.append(["fed merged frames", _fmt_value(max(merged)),
+                     "-", "info"])
+    fstale = _vals("attendance_fed_stale_frames_total")
+    if fstale and max(fstale) > 0:
+        rows.append(["fed stale frames (counters ignored)",
+                     _fmt_value(max(fstale)), "-", "info"])
+    takeovers = _vals("attendance_fed_takeovers_total")
+    if takeovers and max(takeovers) > 0:
+        rows.append(["fed shard takeovers", _fmt_value(max(takeovers)),
+                     "-", "info"])
+    geom = _vals("attendance_fed_geometry_rejects_total")
+    if geom and max(geom) > 0:
+        # A misconfigured peer's frames were rejected: its shard is
+        # missing from the merged view — always a failing verdict.
+        rows.append(["fed geometry-rejected frames",
+                     _fmt_value(max(geom)), "== 0", "FAIL"])
     stale = _vals("attendance_read_staleness_seconds")
     if stale or staleness_ceiling is not None:
         worst = max(stale) if stale else None
@@ -730,6 +786,7 @@ def doctor_report(paths: Sequence[str], *,
                   lane_skew_ceiling: Optional[float] = None,
                   query_p99_ceiling: Optional[float] = None,
                   staleness_ceiling: Optional[float] = None,
+                  merge_lag_ceiling: Optional[float] = None,
                   quarantine_dir: str = ""
                   ) -> Tuple[str, bool]:
     """Replay run artifacts offline; returns (verdict text, ok).
@@ -759,7 +816,8 @@ def doctor_report(paths: Sequence[str], *,
                                      max_reconnects,
                                      lane_skew_ceiling,
                                      query_p99_ceiling,
-                                     staleness_ceiling))
+                                     staleness_ceiling,
+                                     merge_lag_ceiling))
         elif kind == "alerts":
             arows, traces = _alert_checks(payload)
             rows.extend(arows)

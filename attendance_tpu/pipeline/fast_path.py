@@ -445,6 +445,22 @@ class FusedPipeline:
         self.query_engine = None
         if self._obs is not None:
             self.read_mirror.register_gauges(self._obs)
+        # Federation fence gossip (attendance_tpu/federation): when
+        # this pipeline is a federated worker, every snapshot fence
+        # publishes its dirty-bank delta (and full frames at preload/
+        # restore/base) as CRDT merge frames. Constructed BEFORE
+        # restore() so a takeover worker's restored chain reaches the
+        # aggregator immediately. On a multi-process mesh only process
+        # 0 gossips (it holds the replicated state the barriers write).
+        self._fed = None
+        self._events_restored = 0
+        if getattr(self.config, "fed_worker", "") and \
+                jax.process_index() == 0:
+            from attendance_tpu.federation.gossip import FenceGossip
+            self._fed = FenceGossip(
+                self.config, client=self.client,
+                m_bits=self.params.m_bits, k=self.params.k,
+                obs=self._obs).start_heartbeat()
         if self._snap_dir is not None:
             self.restore()
         # Accuracy auditor (obs/audit.py): the hot loop only RECORDS
@@ -515,21 +531,42 @@ class FusedPipeline:
             self._auditor.record_roster(keys)
         self._roster_size = len(keys)
         if not self.sharded and (self.checkpointing
-                                 or self.query_engine is not None):
+                                 or self.query_engine is not None
+                                 or self._fed is not None):
             # Seed the first read epoch (and the snapshot path's host
             # filter cache) from the freshly preloaded state. Gated:
             # plain ingest runs must not pay a D2H here — on the
             # relay-tunneled platform one read of the donated-chain
             # state flips the process into a degraded dispatch mode
             # (see run()'s D2H note), so only runs that will read
-            # host-side anyway (barriers, queries) take it, pre-run
-            # where it is cheapest. The sharded engine publishes its
-            # first epoch at the first barrier instead (its state
-            # gather contains collectives).
+            # host-side anyway (barriers, queries, gossip) take it,
+            # pre-run where it is cheapest. The sharded engine
+            # publishes its first epoch at the first barrier instead
+            # (its state gather contains collectives).
             self._bloom_host = np.asarray(self.state.bloom_bits)
             self._publish_epoch(np.asarray(self.state.hll_regs),
                                 np.asarray(self.state.counts),
                                 bank_of=dict(self._bank_of))
+            if self._fed is not None:
+                # The preloaded filter must reach the aggregator
+                # before any delta (deltas never carry Bloom words):
+                # the federation's zero-false-negative story is the OR
+                # of every shard's preload frame.
+                self._fed.publish_full(
+                    self._bloom_host, np.asarray(self.state.hll_regs),
+                    np.asarray(self.state.counts),
+                    dict(self._bank_of), self._events_total,
+                    roster_size=self._roster_size)
+
+    @property
+    def _events_total(self) -> int:
+        """Cumulative events INCLUDING a restored chain's total — what
+        every durable manifest, read epoch, and gossip frame stamps.
+        ``metrics.events`` alone restarts at 0 across a restore, which
+        would make post-restore deltas look STALE (events <= the
+        base's) to the chain loader's crash-window skip and regress
+        recovered views on a second failover."""
+        return self._events_restored + self.metrics.events
 
     # -- bank mapping -------------------------------------------------------
     def _num_banks(self) -> int:
@@ -1271,6 +1308,11 @@ class FusedPipeline:
         self._regs_mirror = np.array(regs, dtype=np.uint8, copy=True)
         self._publish_epoch(self._regs_mirror, counts,
                             bank_of=dict(self._bank_of))
+        if self._fed is not None:
+            self._fed.publish_full(
+                np.asarray(bits), self._regs_mirror, counts,
+                dict(self._bank_of), self._events_total,
+                roster_size=self._roster_size)
         if jax.process_count() > 1 and jax.process_index() != 0:
             # Multi-controller lockstep (DCN cluster): every process
             # holds the same replicated state, so exactly one writes
@@ -1285,7 +1327,7 @@ class FusedPipeline:
         with self._snap_io_lock:
             self._write_snapshot_files(bits, regs, counts,
                                        dict(self._bank_of),
-                                       self.metrics.events, upto=None)
+                                       self._events_total, upto=None)
         # Only after the write: a raise above leaves the next barrier
         # still owing a full base.
         self._base_stale = False
@@ -1472,6 +1514,22 @@ class FusedPipeline:
             # next read epoch (the atomic swap readers pin against).
             self._publish_epoch(self._regs_mirror, counts,
                                 bank_of=bank_of, events=events)
+        if self._fed is not None:
+            # Fence gossip: the SAME dirty-bank capture that just
+            # became durable ships to the aggregator. A publisher
+            # owing a full frame (an earlier gossip publish failed —
+            # the aggregator may have missed banks) upgrades from the
+            # host mirror instead; durability is never coupled to
+            # gossip success in either direction.
+            if self._fed.full_due and self._regs_mirror is not None \
+                    and self._bloom_host is not None:
+                self._fed.publish_full(
+                    self._bloom_host, self._regs_mirror, counts,
+                    bank_of, events, roster_size=self._roster_size)
+            else:
+                self._fed.publish_delta(
+                    banks, rows, counts, bank_of, events, num_banks,
+                    roster_size=self._roster_size)
         if self._g_delta_bytes is not None:
             self._g_delta_bytes.set(float(nbytes))
             self._g_chain_len.set(float(len(self._snap_chain)))
@@ -1614,6 +1672,10 @@ class FusedPipeline:
             self._publish_epoch(self._regs_mirror, counts_h,
                                 bank_of=job["bank_of"],
                                 events=job["events"])
+            if self._fed is not None:
+                self._fed.publish_full(
+                    job["bloom"], regs_h, counts_h, job["bank_of"],
+                    job["events"], roster_size=self._roster_size)
             self._writer_base_ok = True
             if self._g_chain_len is not None:
                 self._g_chain_len.set(0.0)
@@ -1647,13 +1709,29 @@ class FusedPipeline:
                      if auditor is not None else None)
         self.read_mirror.publish(
             regs=regs_h,
-            events=(self.metrics.events if events is None else events),
+            events=(self._events_total if events is None else events),
             bank_of=bank_of, params=self.params,
             precision=self.config.hll_precision,
             bloom_words=self._bloom_host,
             counts=np.asarray(counts_h) if counts_h is not None
             else None,
             roster_size=self._roster_size, day_truth=day_truth)
+
+    def _gather_host_state(self):
+        """(regs_h, counts_h) after flushing the writer, with
+        ``_bloom_host`` refreshed — the cold-path device read the
+        synchronous publishers share. Performs D2H: call from cold
+        paths only (see run()'s D2H note)."""
+        self._flush_snapshots()
+        if self.sharded:
+            bits, regs = self.engine.get_state()
+            counts = self.engine.get_counts()
+            self._bloom_host = np.asarray(bits)
+            return np.asarray(regs, dtype=np.uint8), counts
+        if self._bloom_host is None:
+            self._bloom_host = np.asarray(self.state.bloom_bits)
+        return (np.asarray(self.state.hll_regs),
+                np.asarray(self.state.counts))
 
     def publish_epoch(self) -> None:
         """Force one synchronous epoch publish from the CURRENT device
@@ -1662,19 +1740,22 @@ class FusedPipeline:
         Performs device reads: call from cold paths (setup, between
         runs), never mid-stream on relay-tunneled devices (see
         run()'s D2H note)."""
-        self._flush_snapshots()
-        if self.sharded:
-            bits, regs = self.engine.get_state()
-            counts = self.engine.get_counts()
-            self._bloom_host = np.asarray(bits)
-            regs_h = np.asarray(regs, dtype=np.uint8)
-        else:
-            if self._bloom_host is None:
-                self._bloom_host = np.asarray(self.state.bloom_bits)
-            regs_h = np.asarray(self.state.hll_regs)
-            counts = np.asarray(self.state.counts)
+        regs_h, counts = self._gather_host_state()
         self._publish_epoch(regs_h, counts,
                             bank_of=dict(self._bank_of))
+
+    def fed_flush(self) -> None:
+        """Publish one FULL merge frame from the current state — the
+        federated worker's end-of-run handshake (the aggregator holds
+        this worker's complete contribution before the process exits).
+        Cold path: performs device reads, like publish_epoch."""
+        if self._fed is None:
+            return
+        regs_h, counts = self._gather_host_state()
+        self._fed.publish_full(self._bloom_host, regs_h, counts,
+                               dict(self._bank_of),
+                               self._events_total,
+                               roster_size=self._roster_size)
 
     def _apply_mirror_rows(self, banks, rows: np.ndarray,
                            num_banks: int) -> None:
@@ -1774,7 +1855,7 @@ class FusedPipeline:
             upto=(self.store.mark()
                   if hasattr(self.store, "mark") else None),
             msgs=[m for m, _, _ in self._inflight],
-            events=self.metrics.events,
+            events=self._events_total,
             bank_of=dict(self._bank_of))
         self._inflight.clear()
         self._batches_at_snap = self.metrics.batches
@@ -1838,6 +1919,19 @@ class FusedPipeline:
         self._regs_mirror = np.array(regs, dtype=np.uint8, copy=True)
         self._publish_epoch(self._regs_mirror, counts,
                             bank_of=self._bank_of, events=events)
+        self._events_restored = int(events)
+        if self._fed is not None:
+            # Takeover path: everything the dead peer made durable is
+            # re-asserted to the aggregator under THIS (higher)
+            # incarnation, and this worker's cumulative event counter
+            # continues from the restored total (_events_total) —
+            # frames the broker redelivers are processed (and counted)
+            # exactly once on top of it, so the federation's
+            # per-worker max-fold can never double-count a replay.
+            self._fed.publish_full(
+                self._bloom_host, self._regs_mirror, counts,
+                dict(self._bank_of), int(events),
+                roster_size=self._roster_size)
         self._base_stale = False
         self._writer_base_ok = True
         self._delta_seq = max(
@@ -1901,10 +1995,10 @@ class FusedPipeline:
         with self._snap_io_lock:
             nbytes = self._write_delta_files(
                 banks, rows, counts, dict(self._bank_of),
-                self.metrics.events, self.engine.num_banks, upto=None)
+                self._events_total, self.engine.num_banks, upto=None)
         self._post_delta_bookkeeping(banks, rows, nbytes, counts,
                                      dict(self._bank_of),
-                                     self.metrics.events,
+                                     self._events_total,
                                      self.engine.num_banks)
 
     # -- ack draining -------------------------------------------------------
@@ -2187,6 +2281,11 @@ class FusedPipeline:
                 serve_http.detach(self._obs._server)
         self._flush_snapshots()
         self._stop_snap_writer()
+        if self._fed is not None:
+            # After the writer drained: the last fence's gossip frame
+            # is published before the producer closes.
+            self._fed.close()
+            self._fed = None
         if hasattr(self.consumer, "lanes"):
             # Striped ingress: stop the lane workers (and their owned
             # sessions) before the client sweep below.

@@ -35,8 +35,7 @@ from typing import Optional
 
 import numpy as np
 
-from attendance_tpu.transport.socket_broker import (
-    _recv_frame, _send_frame)
+from attendance_tpu.transport.framing import recv_frame, send_frame
 from attendance_tpu.transport.resilience import (
     RetryPolicy, resilient_call)
 
@@ -104,7 +103,7 @@ class QueryServer:
         try:
             while True:
                 try:
-                    op, body = _recv_frame(conn)
+                    op, body = recv_frame(conn)
                 except ConnectionError:
                     break
                 try:
@@ -113,7 +112,7 @@ class QueryServer:
                 except Exception as exc:  # protocol keeps flowing
                     status, reply = _ST_ERROR, repr(exc).encode()
                 try:
-                    _send_frame(conn, status, reply)
+                    send_frame(conn, status, reply)
                 except (ConnectionError, OSError):
                     break
         finally:

@@ -29,7 +29,6 @@ crash takeover exactly like in-process.
 from __future__ import annotations
 
 import itertools
-import json
 import logging
 import socket
 import struct
@@ -38,6 +37,11 @@ import time
 from collections import deque
 from typing import Dict, Optional, Tuple
 
+from attendance_tpu.transport.framing import (
+    HDR as _HDR, dec_message_batch, dec_props as _dec_props,
+    enc_message_batch, enc_props as _enc_props, enc_str,
+    recv_exact as _recv_exact, recv_frame as _recv_frame,
+    send_frame as _send_frame)
 from attendance_tpu.transport.memory_broker import (
     MemoryBroker, Message, ReceiveTimeout)
 from attendance_tpu.transport.resilience import (  # noqa: F401 (re-export)
@@ -67,47 +71,14 @@ _ST_ERROR = 2
 # of Config.socket_broker — one constant so the out-of-box recipe works.
 DEFAULT_PORT = 6655
 
-_HDR = struct.Struct("<BI")
 # Server-side cap on one blocking wait; a client "no timeout" receive
 # loops these so a dead server can't hang a client thread forever
-# (socket timeout below is the backstop).
+# (socket timeout below is the backstop). Framing itself (header
+# struct, frame send/recv, props and message-batch encodings) lives in
+# transport.framing — shared with serve/rpc and the federation gossip
+# wire; the leading-underscore aliases above keep this module's
+# historical spellings importable.
 _MAX_WAIT_MS = 10_000
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("connection closed")
-        buf.extend(chunk)
-    return bytes(buf)
-
-
-def _send_frame(sock: socket.socket, code: int, body: bytes) -> None:
-    sock.sendall(_HDR.pack(code, len(body)) + body)
-
-
-def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
-    code, blen = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return code, _recv_exact(sock, blen) if blen else b""
-
-
-def _enc_props(props) -> bytes:
-    """u32-length-prefixed JSON dict; empty/None = zero length."""
-    if not props:
-        return struct.pack("<I", 0)
-    body = json.dumps(props, separators=(",", ":")).encode()
-    return struct.pack("<I", len(body)) + body
-
-
-def _dec_props(body: bytes, off: int):
-    """-> (props_or_None, next_offset)."""
-    (plen,) = struct.unpack_from("<I", body, off)
-    off += 4
-    if not plen:
-        return None, off
-    return json.loads(body[off:off + plen]), off + plen
 
 
 class BrokerServer:
@@ -263,12 +234,7 @@ class BrokerServer:
                         max_n, timeout_millis=timeout_ms)
             except ReceiveTimeout:
                 return _ST_TIMEOUT, b""
-            parts = [struct.pack("<QI", cid, len(msgs))]
-            for mid, data, red, props in msgs:
-                parts.append(struct.pack("<QII", mid, red, len(data)))
-                parts.append(_enc_props(props))
-                parts.append(data)
-            return _ST_OK, b"".join(parts)
+            return _ST_OK, enc_message_batch(cid, msgs)
         if op == _OP_ACK_CHUNK:
             handle, cid = struct.unpack("<IQ", body)
             consumers[handle][0].acknowledge_chunk(cid)
@@ -464,8 +430,7 @@ class SocketProducer:
         self._rpc = rpc
         self._topic = topic
         self._policy = policy or RetryPolicy()
-        t = topic.encode()
-        self._prefix = struct.pack("<H", len(t)) + t
+        self._prefix = enc_str(topic)
         self._closed = False
         self._seq = itertools.count()
         # Client-side telemetry (obs/): wire traffic as seen by THIS
@@ -676,21 +641,9 @@ class SocketConsumer:
             if status == _ST_TIMEOUT:
                 continue  # deadline not reached yet: wait again
             body = _check(status, reply)
-            cid, count = struct.unpack_from("<QI", body)
-            # Payloads are REAL bytes copies on purpose: the native
-            # frame decoder and the CPython-API JSON scanner both
-            # require bytes objects (memoryview slices dead-letter
-            # every frame — measured), and the copy is not the lane's
-            # bottleneck (the 1-core host scheduling is).
-            out, off = [], 12
-            for _ in range(count):
-                mid, red, dlen = struct.unpack_from("<QII", body, off)
-                off += 16
-                props, off = _dec_props(body, off)
-                out.append((mid, body[off:off + dlen], red, props))
-                off += dlen
+            cid, out = dec_message_batch(body)
             if self._obs_msgs is not None:
-                self._obs_msgs.inc(count)
+                self._obs_msgs.inc(len(out))
                 self._obs_bytes.inc(sum(len(t[1]) for t in out))
             return cid, out
 
@@ -824,9 +777,7 @@ class SocketConsumer:
 
 
 def _subscribe_body(topic: str, subscription: str) -> bytes:
-    t, s = topic.encode(), subscription.encode()
-    return (struct.pack("<H", len(t)) + t
-            + struct.pack("<H", len(s)) + s)
+    return enc_str(topic) + enc_str(subscription)
 
 
 class SocketClient:
@@ -895,6 +846,26 @@ class SocketClient:
             consumer._abort()
         self._consumers.clear()
         self._rpc.close()
+
+
+def spawn_broker(*, cwd=None):
+    """Spawn a standalone broker subprocess on an ephemeral port and
+    return ``(proc, addr)`` once its startup line names the address.
+    The caller owns teardown (``proc.kill()``)."""
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "attendance_tpu.transport.socket_broker",
+         "--port", "0"],
+        stdout=subprocess.PIPE, text=True,
+        cwd=None if cwd is None else str(cwd))
+    line = (proc.stdout.readline() or "").strip()
+    if not line:
+        rc = proc.poll()
+        raise RuntimeError(
+            f"broker subprocess died at startup (rc={rc})")
+    return proc, line.rsplit(" ", 1)[-1]
 
 
 def main(argv=None) -> None:

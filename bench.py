@@ -119,6 +119,26 @@ def _enable_compilation_cache() -> None:
     enable_compilation_cache(str(Path(__file__).resolve().parent))
 
 
+def host_fingerprint() -> dict:
+    """The measuring host, stamped on EVERY bench artifact line:
+    cross-host trajectory comparisons are unsound without knowing the
+    core budget, platform, device kind, and whether the mesh was a
+    degenerate single device (SHARDED_r05.json's lone
+    ``degenerate_mesh`` flag used to be the only hint)."""
+    import platform as _platform
+
+    dev = jax.devices()[0]
+    return {
+        "cpu_count": os.cpu_count(),
+        "platform": _platform.platform(),
+        "python": _platform.python_version(),
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "device_platform": dev.platform,
+        "num_devices": jax.device_count(),
+        "degenerate_mesh": jax.device_count() < 2,
+    }
+
+
 def _make_roster(rng, capacity: int) -> np.ndarray:
     return rng.choice(1 << 31, size=capacity, replace=False
                       ).astype(np.uint32)
@@ -850,20 +870,13 @@ def bench_socket(batch_size: int, seconds: float, capacity: int,
     Publisher re-sends cost real TCP time, so passes are shorter than
     the memory-lane e2e; the chunk-lane receive amortizes round-trips
     exactly as in-process."""
-    import subprocess
-    import sys
-
     from attendance_tpu.config import Config
     from attendance_tpu.pipeline.fast_path import FusedPipeline
     from attendance_tpu.pipeline.loadgen import generate_frames
-    from attendance_tpu.transport.socket_broker import SocketClient
+    from attendance_tpu.transport.socket_broker import (
+        SocketClient, spawn_broker)
 
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "attendance_tpu.transport.socket_broker",
-         "--port", "0"],
-        stdout=subprocess.PIPE, text=True,
-        cwd=str(Path(__file__).resolve().parent))
-    addr = proc.stdout.readline().strip().rsplit(" ", 1)[-1]
+    proc, addr = spawn_broker(cwd=Path(__file__).resolve().parent)
     # Teardown registry: every pipeline/client created below cleans up
     # in the finally BEFORE the broker dies — an aborted section (e.g.
     # a loud non-convergence failure) must not leave striped lane
@@ -1105,21 +1118,15 @@ def bench_ingress(seconds: float, capacity: int, num_banks: int,
     Small backlogs + 3 measured passes per shape: this is the CI
     smoke gate, not the artifact bench."""
     import dataclasses
-    import subprocess
-    import sys
 
     from attendance_tpu.config import Config
     from attendance_tpu.pipeline.bridge import JsonBinaryBridge
     from attendance_tpu.pipeline.fast_path import FusedPipeline
     from attendance_tpu.pipeline.loadgen import generate_frames
-    from attendance_tpu.transport.socket_broker import SocketClient
+    from attendance_tpu.transport.socket_broker import (
+        SocketClient, spawn_broker)
 
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "attendance_tpu.transport.socket_broker",
-         "--port", "0"],
-        stdout=subprocess.PIPE, text=True,
-        cwd=str(Path(__file__).resolve().parent))
-    addr = proc.stdout.readline().strip().rsplit(" ", 1)[-1]
+    proc, addr = spawn_broker(cwd=Path(__file__).resolve().parent)
     # Same teardown registry as bench_socket: an aborted section must
     # not leave lane workers retrying against a killed broker.
     cleanups = []
@@ -1362,6 +1369,224 @@ def bench_ingress(seconds: float, capacity: int, num_banks: int,
                 pass  # best effort: the broker may already be dead
         proc.kill()
         proc.wait()
+
+
+def bench_federation(seconds: float, ks: list, seed: int = 0) -> dict:
+    """Federated multi-host scale-out (ISSUE 8 / ROADMAP item 4):
+    aggregate ingest scaling at K local worker processes, merge lag,
+    and federated query throughput.
+
+    Per K in ``ks`` (K=1 first — its lone worker also warms the
+    shared XLA cache for the bigger rounds): K
+    ``attendance_tpu.federation.worker`` subprocesses each own one
+    hash shard of the shared deterministic roster, self-feed their
+    shard's frames over the in-process memory broker (pure
+    ingest-scaling shape — the striped-socket ingress has its own
+    bench), checkpoint in delta mode, and gossip every fence as merge
+    frames to a REAL socket BrokerServer subprocess; this process
+    runs the aggregator, folding the gossip stream live into the
+    global CRDT view. Workers gate their measured window on a shared
+    go-file so walls overlap, and the aggregate rate is
+    sum(events) / max(worker wall). After the drain the merged view
+    must hold exactly K*N events and answer BF.EXISTS over the FULL
+    roster with zero false negatives (the union-of-preload-frames
+    guarantee), then serves the federated query-throughput columns.
+
+    Host-scaled K=2 gate (the ingress smoke's form): on a > 2-core
+    host K=2 must reach >= 1.8x K=1; on a <= 2-core host two worker
+    processes + broker + aggregator already oversubscribe the cores,
+    so the gate degrades to no-regression (>= 0.9x)."""
+    import tempfile
+
+    from attendance_tpu.federation.worker import (
+        DEFAULT_BATCH, DEFAULT_ROSTER, full_roster)
+    from attendance_tpu.transport.socket_broker import spawn_broker
+
+    ncpu = os.cpu_count() or 1
+    per_worker = int(min(max(1 << 16, seconds * 250_000), 1 << 19))
+    per_worker = max(DEFAULT_BATCH,
+                     (per_worker // DEFAULT_BATCH) * DEFAULT_BATCH)
+    roster = full_roster(seed, DEFAULT_ROSTER)
+
+    proc, addr = spawn_broker(cwd=Path(__file__).resolve().parent)
+    rounds: dict = {}
+    try:
+        for K in sorted(ks):
+            with tempfile.TemporaryDirectory() as workdir:
+                rounds[K] = _federation_round(
+                    addr, K, per_worker, roster, seed, workdir)
+    finally:
+        proc.kill()
+        proc.wait()
+
+    r1 = rounds.get(1)
+    rates = {K: r["aggregate_events_per_sec"]
+             for K, r in rounds.items()}
+    scaling_frac = (rates[2] / rates[1]
+                    if 1 in rates and 2 in rates and rates[1]
+                    else None)
+    lags = sorted(lag for r in rounds.values()
+                  for lag in r.pop("merge_lags_s"))
+
+    def pct(p):
+        return (round(lags[min(len(lags) - 1,
+                               int(p * (len(lags) - 1)))], 4)
+                if lags else None)
+
+    return {
+        "ks": sorted(rounds),
+        "per_worker_events": per_worker,
+        "aggregate_events_per_sec": {
+            str(K): round(v, 1) for K, v in rates.items()},
+        "per_round": {str(K): r for K, r in rounds.items()},
+        "scaling_frac_k2": (round(scaling_frac, 4)
+                            if scaling_frac is not None else None),
+        "scaling_gate": ("k2 >= 1.8x k1" if ncpu > 2
+                         else "no-regression (<=2-core host)"),
+        "scaling_pass": (scaling_frac is None
+                         or scaling_frac >= (1.8 if ncpu > 2
+                                             else 0.9)),
+        "merge_lag_p50_s": pct(0.50),
+        "merge_lag_p99_s": pct(0.99),
+        "merge_lag_max_s": (round(lags[-1], 4) if lags else None),
+        "merged_frames": sum(r["frames_folded"]
+                             for r in rounds.values()),
+        "zero_false_negatives": all(r["zero_false_negatives"]
+                                    for r in rounds.values()),
+        "events_exact": all(r["events_exact"]
+                            for r in rounds.values()),
+        "fed_query_point_qps": (r1 or {}).get("query_point_qps"),
+        "fed_query_table_qps": (r1 or {}).get("query_table_qps"),
+        "device": str(jax.devices()[0]),
+    }
+
+
+def _federation_round(addr: str, K: int, per_worker: int,
+                      roster: np.ndarray, seed: int,
+                      workdir: str) -> dict:
+    """One K-worker federation round against a live broker at
+    ``addr``; returns the round's rate/lag/audit columns."""
+    import subprocess
+    import sys
+
+    from attendance_tpu.federation.gossip import Aggregator
+    from attendance_tpu.serve.engine import QueryEngine
+    from attendance_tpu.transport.socket_broker import SocketClient
+
+    topic = f"bench-fed-gossip-k{K}"
+    # Keep the client handle: Aggregator treats a caller-supplied
+    # client as caller-owned, so stop() alone would leak its
+    # producer-channel connection into the next K-round.
+    agg_client = SocketClient(addr)
+    agg = Aggregator(client=agg_client, topic=topic,
+                     num_shards=K, dead_after_s=1e9, precision=14)
+    merge_lags: list = []
+    fold0 = agg.fold_frame
+    agg.fold_frame = lambda frame, now=None: _note_lag(
+        fold0(frame, now), merge_lags)
+    go_file = os.path.join(workdir, "go")
+    workers = []
+    try:
+        for s in range(K):
+            ready = os.path.join(workdir, f"ready-{s}")
+            workers.append((subprocess.Popen(
+                [sys.executable, "-m",
+                 "attendance_tpu.federation.worker",
+                 "--worker", f"w{s}", "--shard", str(s),
+                 "--num-shards", str(K), "--broker", addr,
+                 "--gossip-topic", topic,
+                 "--workdir", workdir, "--data-plane", "memory",
+                 "--num-events", str(per_worker),
+                 "--max-events", str(per_worker),
+                 "--seed", str(seed), "--idle-timeout-s", "10",
+                 "--ready-file", ready, "--go-file", go_file],
+                stdout=subprocess.PIPE, text=True,
+                cwd=str(Path(__file__).resolve().parent)), ready))
+        deadline = time.time() + 600
+        for p, ready in workers:
+            while not os.path.exists(ready):
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"federation worker died before ready (K={K}, "
+                        f"rc={p.returncode}):\n"
+                        + (p.stdout.read() or ""))
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f"federation worker never became ready (K={K})")
+                agg.poll(timeout_ms=50)  # fold preload fulls meanwhile
+        Path(go_file).touch()
+        while any(p.poll() is None for p, _ in workers):
+            agg.poll(timeout_ms=100)
+        reports = []
+        for p, _ in workers:
+            out = (p.stdout.read() or "").strip().splitlines()
+            if p.returncode != 0 or not out:
+                raise RuntimeError(
+                    f"federation worker failed (K={K}, "
+                    f"rc={p.returncode})")
+            reports.append(json.loads(out[-1]))
+        # Drain the tail of the gossip stream (final fulls included).
+        quiet = 0
+        while quiet < 3:
+            quiet = quiet + 1 if agg.poll(timeout_ms=100) == 0 else 0
+        total = sum(r["events"] for r in reports)
+        measured = sum(r["measured_events"] for r in reports)
+        wall = max(r["wall_s"] for r in reports)
+        engine = QueryEngine(agg.mirror)
+        qps, table_qps = _fed_query_rates(engine, roster)
+        return {
+            "worker_events_per_sec": [r["events_per_sec"]
+                                      for r in reports],
+            "worker_walls_s": [r["wall_s"] for r in reports],
+            "aggregate_events_per_sec": (measured / wall
+                                         if wall else 0.0),
+            "events_total": total,
+            "events_exact": int(agg.view.events) == total == K * per_worker,
+            "zero_false_negatives":
+                bool(engine.bf_exists(roster).all()),
+            "frames_folded": (agg.view.folded_deltas
+                              + agg.view.folded_fulls),
+            "stale_frames": agg.view.stale_frames,
+            "merge_lags_s": merge_lags,
+            "query_point_qps": qps,
+            "query_table_qps": table_qps,
+        }
+    finally:
+        for p, _ in workers:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        agg.stop()
+        agg_client.close()
+
+
+def _note_lag(info: dict, sink: list):
+    if info.get("lag_s") is not None:
+        sink.append(info["lag_s"])
+    return info
+
+
+def _fed_query_rates(engine, roster: np.ndarray,
+                     window_s: float = 1.5) -> tuple:
+    """(point qps over 64-key BF.EXISTS batches, occupancy-table
+    qps) against the aggregator's merged view."""
+    rng = np.random.default_rng(1)
+    bufs = [np.where(rng.random(64) < 0.5,
+                     rng.choice(roster, 64),
+                     rng.integers(1 << 31, 1 << 32, 64
+                                  ).astype(np.uint32)).astype(np.uint32)
+            for _ in range(16)]
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < window_s:
+        engine.bf_exists(bufs[n % len(bufs)])
+        n += 1
+    qps = round(n * 64 / (time.perf_counter() - t0), 1)
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < min(window_s, 1.0):
+        engine.occupancy()
+        n += 1
+    table_qps = round(n / (time.perf_counter() - t0), 1)
+    return qps, table_qps
 
 
 def _build_roster_filter(capacity: int):
@@ -1789,7 +2014,7 @@ def main() -> None:
                              "sharded", "bloom", "hll", "roster10m",
                              "roster10m-tpu", "roster10m-accept",
                              "snapshot", "socket", "probe", "obs",
-                             "ingress", "query"],
+                             "ingress", "query", "federation"],
                     help="both/kernel/e2e are the headline benches; "
                     "json times the reference-wire JSON ingress "
                     "(bridge -> fused pipe); wires compares the forced "
@@ -1806,6 +2031,9 @@ def main() -> None:
     ap.add_argument("--lanes", default="1,4",
                     help="comma-separated lane counts for "
                     "--mode=ingress (e.g. 1,4)")
+    ap.add_argument("--fed-ks", default="1,2,4",
+                    help="comma-separated federation sizes (local "
+                    "worker processes) for --mode=federation")
     ap.add_argument("--no-strict-convergence", action="store_true",
                     help="downgrade the socket/striped sections' "
                     "non-convergence failure to a stderr warning "
@@ -2013,6 +2241,17 @@ def main() -> None:
                     "scaling_gate", "scaling_pass",
                     "binary_scaling_frac", "device")},
             }
+        elif args.mode == "federation":
+            ks = sorted({int(x) for x in args.fed_ks.split(",") if x})
+            r = bench_federation(args.seconds, ks)
+            best = max(r["aggregate_events_per_sec"].values())
+            line = {
+                "metric": "federation_aggregate_events_per_sec",
+                "value": best,
+                "unit": "events/sec",
+                "vs_baseline": round(_vs_baseline(best), 4),
+                **{k: v for k, v in r.items()},
+            }
         elif args.mode == "query":
             r = bench_query(args.e2e_batch_size, args.seconds,
                             args.capacity, args.num_banks)
@@ -2210,6 +2449,10 @@ def main() -> None:
                 "snapshots_taken": snap["snapshots_taken"],
                 "snapshot_every_batches": snap["snapshot_every_batches"],
             }
+    # Every artifact names its measuring host (cross-host trajectory
+    # comparisons were unsound without it — the satellite fix riding
+    # ISSUE 8).
+    line["host"] = host_fingerprint()
     print(json.dumps(line))
 
 

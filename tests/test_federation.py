@@ -1,0 +1,749 @@
+"""Federation plane (attendance_tpu/federation): CRDT merge-core
+property tests (commutativity / associativity / idempotence of Bloom-OR
+and HLL register-max on the numpy AND device paths), merge-of-deltas ==
+merge-of-full-states, random K-way interleavings converging to the
+single-process oracle, the versioned merge-frame wire, the shard map,
+fence-gossip end to end over in-process pipelines, dead-peer chain
+recovery, and the doctor's merge-lag rows.
+"""
+
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attendance_tpu import obs
+from attendance_tpu.config import Config
+from attendance_tpu.federation.frames import (
+    FRAME_VERSION, MergeFrame, decode_frame, encode_frame)
+from attendance_tpu.federation.gossip import Aggregator, FenceGossip
+from attendance_tpu.federation.merge import GeometryMismatch, MergedView
+from attendance_tpu.federation.shard import (
+    ShardMap, shard_of_keys, shard_topic)
+from attendance_tpu.models.bloom import bloom_or_words, bloom_or_words_np
+from attendance_tpu.models.hll import hll_merge, hll_merge_np
+from attendance_tpu.pipeline.fast_path import FusedPipeline
+from attendance_tpu.pipeline.loadgen import (
+    frame_from_columns, generate_frames, synth_columns)
+from attendance_tpu.serve.engine import QueryEngine
+from attendance_tpu.transport.memory_broker import (
+    MemoryBroker, MemoryClient)
+
+M = 1 << 8  # small register width for property tests (not the real 2^14)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    obs.disable()
+    yield
+    obs.disable()
+
+
+# -- CRDT property tests -----------------------------------------------------
+
+def _rand_words(rng, n=64):
+    return rng.integers(0, 1 << 32, n, dtype=np.uint32)
+
+
+def _rand_regs(rng, banks, m=M):
+    # Realistic HLL register range is [0, ~50]; uint8 keeps max exact.
+    return rng.integers(0, 51, (banks, m), dtype=np.uint8)
+
+
+def test_bloom_or_np_properties():
+    rng = np.random.default_rng(0)
+    a, b, c = (_rand_words(rng) for _ in range(3))
+    assert (bloom_or_words_np(a, b) == bloom_or_words_np(b, a)).all()
+    assert (bloom_or_words_np(bloom_or_words_np(a, b), c)
+            == bloom_or_words_np(a, bloom_or_words_np(b, c))).all()
+    assert (bloom_or_words_np(a, a) == a).all()
+    # Merging in a filter's own state is a no-op (idempotence under
+    # replay — the failover safety property).
+    ab = bloom_or_words_np(a, b)
+    assert (bloom_or_words_np(ab, b) == ab).all()
+
+
+def test_bloom_or_np_geometry_mismatch():
+    rng = np.random.default_rng(1)
+    with pytest.raises(ValueError):
+        bloom_or_words_np(_rand_words(rng, 64), _rand_words(rng, 32))
+
+
+def test_bloom_or_device_matches_np():
+    rng = np.random.default_rng(2)
+    a, b = _rand_words(rng), _rand_words(rng)
+    dev = np.asarray(bloom_or_words(jnp.asarray(a), jnp.asarray(b)))
+    assert (dev == bloom_or_words_np(a, b)).all()
+
+
+def test_hll_merge_np_properties():
+    rng = np.random.default_rng(3)
+    a, b, c = (_rand_regs(rng, 4) for _ in range(3))
+    assert (hll_merge_np(a, b) == hll_merge_np(b, a)).all()
+    assert (hll_merge_np(hll_merge_np(a, b), c)
+            == hll_merge_np(a, hll_merge_np(b, c))).all()
+    assert (hll_merge_np(a, a) == a).all()
+
+
+def test_hll_merge_np_bank_growth():
+    # Replicas that grew their bank arrays at different times merge
+    # with the shorter stack zero-extended (0 is max's identity).
+    rng = np.random.default_rng(4)
+    a, b = _rand_regs(rng, 2), _rand_regs(rng, 5)
+    out = hll_merge_np(a, b)
+    assert out.shape == (5, M)
+    assert (out[:2] == np.maximum(a, b[:2])).all()
+    assert (out[2:] == b[2:]).all()
+    assert (hll_merge_np(a, b) == hll_merge_np(b, a)).all()
+
+
+def test_hll_merge_np_width_mismatch():
+    rng = np.random.default_rng(5)
+    with pytest.raises(ValueError):
+        hll_merge_np(_rand_regs(rng, 2, 64), _rand_regs(rng, 2, 128))
+
+
+def test_hll_merge_device_matches_np():
+    rng = np.random.default_rng(6)
+    a, b = _rand_regs(rng, 4), _rand_regs(rng, 4)
+    dev = np.asarray(hll_merge(jnp.asarray(a), jnp.asarray(b)))
+    assert (dev == hll_merge_np(a, b)).all()
+
+
+# -- merge-frame wire --------------------------------------------------------
+
+def _mk_frame_bytes(worker="w0", kind="delta", seq=0, incarnation=1.0,
+                    events=100, bank_of=None, arrays=None, **kw):
+    bank_of = {20260701: 0, 20260702: 1} if bank_of is None else bank_of
+    if arrays is None and kind == "delta":
+        arrays = {"bank_idx": np.array([0, 1], np.int32),
+                  "rows": np.zeros((2, 1 << 14), np.uint8),
+                  "counts": np.zeros((2, 2), np.uint32)}
+    return encode_frame(
+        worker=worker, kind=kind, incarnation=incarnation, seq=seq,
+        shard=0, fence_ts=time.time(), events=events, bank_of=bank_of,
+        m_bits=1 << 10, k=7, precision=14, arrays=arrays, **kw)
+
+
+def test_frame_roundtrip():
+    rng = np.random.default_rng(7)
+    rows = rng.integers(0, 51, (3, 1 << 14), dtype=np.uint8)
+    payload = _mk_frame_bytes(
+        arrays={"bank_idx": np.array([4, 0, 2], np.int32),
+                "rows": rows,
+                "counts": np.array([[9, 0], [1, 0]], np.uint32)},
+        bank_of={20260701: 4, 20260702: 0, 20260703: 2})
+    frame = decode_frame(payload)
+    assert frame.worker == "w0" and frame.kind == "delta"
+    assert frame.bank_of == {20260701: 4, 20260702: 0, 20260703: 2}
+    assert (frame.arrays["rows"] == rows).all()
+    assert frame.arrays["bank_idx"].dtype == np.int32
+    assert frame.events == 100 and frame.m_bits == 1 << 10
+
+
+def test_frame_version_gate():
+    payload = bytearray(_mk_frame_bytes(kind="heartbeat", arrays={}))
+    payload[0:2] = (FRAME_VERSION + 1).to_bytes(2, "little")
+    with pytest.raises(ValueError, match="version"):
+        decode_frame(bytes(payload))
+
+
+def test_frame_unknown_kind_rejected():
+    with pytest.raises(ValueError):
+        encode_frame(worker="w0", kind="gossip?", incarnation=1.0,
+                     seq=0, shard=0, fence_ts=0.0, events=0)
+
+
+# -- shard map ---------------------------------------------------------------
+
+def test_shard_of_keys_partitions():
+    keys = np.arange(10_000, 60_000, dtype=np.uint32)
+    shards = shard_of_keys(keys, 4)
+    assert shards.min() >= 0 and shards.max() < 4
+    # Balanced within a generous tolerance (hash partition).
+    counts = np.bincount(shards, minlength=4)
+    assert counts.min() > len(keys) // 8
+    # Deterministic and independent of array order.
+    assert (shard_of_keys(keys[::-1], 4)[::-1] == shards).all()
+    assert shard_topic("events", 2) == "events.s2"
+
+
+def test_shard_map_versioning():
+    m = ShardMap(3)
+    assert m.version == 1
+    assert m.claim(0, "w0") and m.claim(1, "w1") and m.claim(2, "w2")
+    assert m.version == 1  # startup claims are not reassignments
+    assert not m.claim(0, "w0")  # idempotent re-claim
+    moved = m.reassign("w1", None)
+    assert moved == [1] and m.version == 2 and m.owner_of(1) is None
+    # A takeover claiming the ORPHANED shard is a fresh claim (the
+    # reassignment already bumped); claiming over a LIVE owner bumps.
+    assert m.claim(1, "w9") and m.version == 2
+    assert m.shards_of("w9") == [1]
+    assert m.claim(2, "w9") and m.version == 3
+    with pytest.raises(ValueError):
+        m.claim(5, "w0")
+
+
+# -- merge core: deltas vs fulls, interleavings, staleness -------------------
+
+def _worker_stream(rng, worker, shard, days, n_frames=6, p=14):
+    """A plausible fence stream: one full frame then deltas, with
+    monotone counters and per-worker day->bank assignment in arrival
+    order."""
+    m = 1 << p
+    bank_of, regs = {}, np.zeros((0, m), np.uint8)
+    bloom = rng.integers(0, 1 << 32, 128, dtype=np.uint32)
+    frames, events = [], 0
+    for seq in range(n_frames):
+        # Touch a random subset of days; maybe discover a new one. A
+        # newly discovered bank is always dirty (a day only registers
+        # because events landed in it), exactly like the pipeline's
+        # dirty-day capture — so every bank is named by some delta.
+        new_banks = []
+        for day in rng.choice(days, rng.integers(1, len(days) + 1),
+                              replace=False):
+            if int(day) not in bank_of:
+                bank_of[int(day)] = len(bank_of)
+                new_banks.append(len(bank_of) - 1)
+                regs = np.vstack([regs, np.zeros((1, m), np.uint8)])
+        touched = rng.choice(list(bank_of.values()),
+                             rng.integers(1, len(bank_of) + 1),
+                             replace=False)
+        touched = np.unique(np.concatenate(
+            [touched, np.asarray(new_banks, touched.dtype)])
+        ).astype(np.int64)
+        bump = np.zeros_like(regs)
+        idx = (rng.integers(0, m, 64), )
+        for b in touched:
+            bump[b][idx] = rng.integers(1, 51, 64)
+        regs = np.maximum(regs, bump)
+        events += int(rng.integers(100, 1000))
+        counts = np.zeros((2, 2), np.uint32)
+        counts[0, 0] = events
+        common = dict(worker=worker, incarnation=1.0, seq=seq,
+                      shard=shard, fence_ts=time.time(), events=events,
+                      bank_of=dict(bank_of), m_bits=1 << 12, k=5,
+                      precision=p, num_banks=regs.shape[0])
+        if seq == 0:
+            frames.append(encode_frame(kind="full", arrays={
+                "bloom": bloom, "regs": regs.copy(),
+                "counts": counts}, **common))
+        else:
+            frames.append(encode_frame(kind="delta", arrays={
+                "bank_idx": touched.astype(np.int32),
+                "rows": regs[touched].copy(),
+                "counts": counts}, **common))
+    final = dict(bank_of=bank_of, regs=regs, bloom=bloom,
+                 events=events)
+    return frames, final
+
+
+def _fold_all(payloads, p=14):
+    view = MergedView(p)
+    for payload in payloads:
+        view.fold(decode_frame(payload))
+    return view
+
+
+def _oracle(finals):
+    regs_by_day, bloom = {}, None
+    for f in finals:
+        inv = {b: d for d, b in f["bank_of"].items()}
+        for b, d in inv.items():
+            row = f["regs"][b]
+            regs_by_day[d] = (np.maximum(regs_by_day[d], row)
+                              if d in regs_by_day else row.copy())
+        bloom = f["bloom"] if bloom is None \
+            else bloom_or_words_np(bloom, f["bloom"])
+    return regs_by_day, bloom
+
+
+def test_merge_of_deltas_equals_merge_of_fulls():
+    rng = np.random.default_rng(8)
+    days = [20260701 + i for i in range(5)]
+    frames, final = _worker_stream(rng, "w0", 0, days)
+    by_deltas = _fold_all(frames)
+    # One full frame carrying the worker's end state.
+    counts = np.zeros((2, 2), np.uint32)
+    counts[0, 0] = final["events"]
+    full = encode_frame(
+        worker="w0", kind="full", incarnation=1.0, seq=99, shard=0,
+        fence_ts=time.time(), events=final["events"],
+        bank_of=final["bank_of"], m_bits=1 << 12, k=5, precision=14,
+        arrays={"bloom": final["bloom"], "regs": final["regs"],
+                "counts": counts})
+    by_full = _fold_all([full])
+    assert by_deltas.events == by_full.events == final["events"]
+    a, b = by_deltas.regs_by_day(), by_full.regs_by_day()
+    assert set(a) == set(b)
+    for day in a:
+        assert (a[day] == b[day]).all(), day
+    assert (by_deltas.bloom_words == by_full.bloom_words).all()
+
+
+@pytest.mark.parametrize("trial", range(3))
+def test_kway_interleavings_converge_to_oracle(trial):
+    rng = np.random.default_rng(100 + trial)
+    days = [20260701 + i for i in range(4)]
+    streams, finals = [], []
+    for w in range(3):
+        frames, final = _worker_stream(rng, f"w{w}", w, days,
+                                       n_frames=5)
+        streams.append(frames)
+        finals.append(final)
+    oracle_regs, oracle_bloom = _oracle(finals)
+    # Random global interleaving preserving NOTHING (not even
+    # per-worker order), plus a duplicated random subset: OR/max make
+    # both harmless; only counters need the (incarnation, seq) fold.
+    merged = [f for s in streams for f in s]
+    order = rng.permutation(len(merged))
+    payloads = [merged[i] for i in order]
+    dup = [merged[i] for i in
+           rng.choice(len(merged), 4, replace=False)]
+    view = _fold_all(payloads + dup)
+    assert view.events == sum(f["events"] for f in finals)
+    got = view.regs_by_day()
+    assert set(got) == set(oracle_regs)
+    for day in got:
+        assert (got[day] == oracle_regs[day]).all(), day
+    assert (view.bloom_words == oracle_bloom).all()
+
+
+def test_stale_incarnation_counters_ignored_sketch_folded():
+    view = MergedView(14)
+    m = 1 << 14
+    regs2 = np.zeros((1, m), np.uint8)
+    regs2[0, 7] = 9
+    counts = np.zeros((2, 2), np.uint32)
+    view.fold(MergeFrame(
+        dict(worker="w0", kind="full", incarnation=2.0, seq=0, shard=0,
+             fence_ts=time.time(), events=500, roster_size=10,
+             m_bits=64, k=3, precision=14, bank_of={20260701: 0}),
+        dict(bloom=np.array([1, 0], np.uint32), regs=regs2,
+             counts=counts)))
+    # A LATE frame from the dead incarnation 1.0: more events claimed,
+    # a register the takeover never saw.
+    regs1 = np.zeros((1, m), np.uint8)
+    regs1[0, 3] = 21
+    info = view.fold(MergeFrame(
+        dict(worker="w0", kind="full", incarnation=1.0, seq=9, shard=0,
+             fence_ts=time.time(), events=9_999, roster_size=10,
+             m_bits=64, k=3, precision=14, bank_of={20260701: 0}),
+        dict(bloom=np.array([0, 2], np.uint32), regs=regs1,
+             counts=counts)))
+    assert info["stale"]
+    assert view.stale_frames == 1
+    assert view.events == 500  # stale counters never fold
+    row = view.regs_by_day()[20260701]
+    assert row[7] == 9 and row[3] == 21  # sketch state still folded
+    assert (view.bloom_words == np.array([1, 2], np.uint32)).all()
+
+
+def test_stale_frame_does_not_refresh_liveness():
+    """A superseded zombie's heartbeats must not keep the worker-id
+    ledger fresh: the takeover successor (same id, higher incarnation)
+    owns liveness, or its own death could never be detected."""
+    view = MergedView(14)
+    hdr = dict(worker="w0", kind="heartbeat", shard=0,
+               fence_ts=0.0, events=1, roster_size=1,
+               m_bits=0, k=0, precision=14, bank_of={})
+    view.fold(MergeFrame(dict(hdr, incarnation=2.0, seq=0), {}),
+              now=100.0)
+    assert view.workers["w0"].last_seen == 100.0
+    # Zombie old-incarnation heartbeat much later: stale, no refresh.
+    info = view.fold(MergeFrame(dict(hdr, incarnation=1.0, seq=9), {}),
+                     now=500.0)
+    assert info["stale"]
+    assert view.workers["w0"].last_seen == 100.0
+    # Current-incarnation traffic still refreshes.
+    view.fold(MergeFrame(dict(hdr, incarnation=2.0, seq=1), {}),
+              now=600.0)
+    assert view.workers["w0"].last_seen == 600.0
+
+
+def test_claim_incarnation_monotonic_across_takeovers(tmp_path):
+    """Successive claims on one chain dir strictly increase even when
+    the claimant's wall clock trails the previous owner's (the
+    cross-host takeover case)."""
+    from attendance_tpu.federation.gossip import claim_incarnation
+
+    d = str(tmp_path / "chain")
+    inc1 = claim_incarnation(d)
+    inc2 = claim_incarnation(d)
+    assert inc2 > inc1
+    # Previous owner minted on a clock far ahead of ours: the durable
+    # high-water mark still wins over time.time().
+    (tmp_path / "chain" / "INCARNATION").write_text("9e9")
+    assert claim_incarnation(d) > 9e9
+    # No chain dir configured: plain wall clock.
+    assert claim_incarnation("") > 0
+
+
+def test_geometry_mismatch_fails_loudly():
+    view = MergedView(14)
+    counts = np.zeros((2, 2), np.uint32)
+    view.fold(MergeFrame(
+        dict(worker="w0", kind="full", incarnation=1.0, seq=0, shard=0,
+             fence_ts=0.0, events=0, roster_size=0, m_bits=256, k=3,
+             precision=14, bank_of={}),
+        dict(bloom=np.zeros(8, np.uint32),
+             regs=np.zeros((1, 1 << 14), np.uint8), counts=counts)))
+    with pytest.raises(GeometryMismatch):
+        view.fold(MergeFrame(
+            dict(worker="w1", kind="full", incarnation=1.0, seq=0,
+                 shard=1, fence_ts=0.0, events=0, roster_size=0,
+                 m_bits=512, k=3, precision=14, bank_of={}),
+            dict(bloom=np.zeros(16, np.uint32),
+                 regs=np.zeros((1, 1 << 14), np.uint8),
+                 counts=counts)))
+    with pytest.raises(GeometryMismatch):
+        view.fold(MergeFrame(
+            dict(worker="w2", kind="full", incarnation=1.0, seq=0,
+                 shard=1, fence_ts=0.0, events=0, roster_size=0,
+                 m_bits=256, k=3, precision=12, bank_of={}), {}))
+    # Same m_bits, different probe count: the reader would probe k
+    # positions the writer never set — false negatives, so reject.
+    with pytest.raises(GeometryMismatch):
+        view.fold(MergeFrame(
+            dict(worker="w3", kind="full", incarnation=1.0, seq=0,
+                 shard=1, fence_ts=0.0, events=0, roster_size=0,
+                 m_bits=256, k=5, precision=14, bank_of={}),
+            dict(bloom=np.zeros(8, np.uint32),
+                 regs=np.zeros((1, 1 << 14), np.uint8),
+                 counts=counts)))
+
+
+def test_aggregator_rejects_geometry_loudly_and_keeps_serving():
+    """A misconfigured peer's frames are dropped with attribution (the
+    geometry_rejects counter doctor fails on), never folded, and never
+    allowed to kill the aggregator's poll loop."""
+    from attendance_tpu.transport.memory_broker import MemoryBroker
+    broker = MemoryBroker()
+    agg = Aggregator(client=MemoryClient(broker), topic="geo-gossip",
+                     num_shards=2, dead_after_s=1e9, precision=14)
+    producer = MemoryClient(broker).create_producer("geo-gossip")
+    counts = np.zeros((2, 2), np.uint32)
+    good = encode_frame(
+        worker="w0", kind="full", incarnation=1.0, seq=0, shard=0,
+        fence_ts=time.time(), events=10, m_bits=256, k=3, precision=14,
+        bank_of={}, arrays=dict(bloom=np.zeros(8, np.uint32),
+                                regs=np.zeros((1, 1 << 14), np.uint8),
+                                counts=counts))
+    bad = encode_frame(
+        worker="w1", kind="full", incarnation=1.0, seq=0, shard=1,
+        fence_ts=time.time(), events=7, m_bits=256, k=5, precision=14,
+        bank_of={}, arrays=dict(bloom=np.zeros(8, np.uint32),
+                                regs=np.zeros((1, 1 << 14), np.uint8),
+                                counts=counts))
+    producer.send(good)
+    producer.send(bad)
+    producer.send(good)  # the good peer keeps folding after the reject
+    try:
+        folded = _drain(agg, min_folds=2)
+        assert folded == 2
+        assert agg.geometry_rejects == 1
+        stats = agg.stats()
+        assert stats["geometry_rejects"] == 1
+        assert "w1" not in stats["workers"] or \
+            stats["workers"]["w1"]["events"] == 0
+        assert stats["events"] == 10  # the bad peer's counters never fold
+    finally:
+        agg.stop()
+
+
+# -- fence gossip end to end (in-process pipelines) --------------------------
+
+def _federated_pipes(broker, tmp, K, roster, num_banks=8,
+                     snapshot_every=2):
+    pipes = []
+    for s in range(K):
+        cfg = Config(
+            bloom_filter_capacity=20_000, transport_backend="memory",
+            pulsar_topic=f"events.s{s}",
+            snapshot_dir=str(tmp / f"w{s}"),
+            snapshot_every_batches=snapshot_every,
+            fed_worker=f"w{s}", fed_shard=s, fed_shards=K,
+            fed_gossip_topic="fed-gossip",
+            fed_heartbeat_s=0.0).validate()
+        client = MemoryClient(broker)
+        pipe = FusedPipeline(cfg, client=client, num_banks=num_banks)
+        mine = roster[shard_of_keys(roster, K) == s]
+        pipe.preload(mine)
+        pipes.append((pipe, client, mine))
+    return pipes
+
+
+def _drain(agg, min_folds=0):
+    folded = 0
+    for _ in range(100):
+        n = agg.poll(timeout_ms=50)
+        folded += n
+        if n == 0 and folded >= min_folds:
+            break
+    return folded
+
+
+def test_gossip_end_to_end_two_workers(tmp_path):
+    broker = MemoryBroker()
+    K = 2
+    roster, _ = generate_frames(0, 1, roster_size=6_000,
+                                num_lectures=6, seed=3)
+    agg = Aggregator(client=MemoryClient(broker), topic="fed-gossip",
+                     num_shards=K, dead_after_s=30.0, precision=14)
+    pipes = _federated_pipes(broker, tmp_path, K, roster)
+    try:
+        total = 0
+        for s, (pipe, client, mine) in enumerate(pipes):
+            rng = np.random.default_rng(100 + s)
+            prod = client.create_producer(pipe.config.pulsar_topic)
+            n = 0
+            for _ in range(4):
+                prod.send(frame_from_columns(synth_columns(
+                    rng, 2_048, mine, 6, 0.1, invalid_base=200_000)))
+                n += 2_048
+            pipe.run(max_events=n, idle_timeout_s=0.5)
+            pipe.snapshot()
+            pipe.fed_flush()
+            total += n
+        _drain(agg, min_folds=K)
+        assert agg.view.events == total
+        assert agg.view.folded_deltas > 0  # fences really gossiped deltas
+        # Zero false negatives over the FULL federation roster: the
+        # global filter is the OR of every shard's preload frame.
+        eng = QueryEngine(agg.mirror)
+        assert eng.bf_exists(roster).all()
+        # Registers equal the per-worker oracle merge, day-keyed.
+        oracle = {}
+        for pipe, _, _ in pipes:
+            regs = np.asarray(pipe.state.hll_regs)
+            for day, bank in pipe._bank_of.items():
+                oracle[day] = (np.maximum(oracle[day], regs[bank])
+                               if day in oracle else regs[bank].copy())
+        got = agg.view.regs_by_day()
+        assert set(got) == set(oracle)
+        for day in oracle:
+            assert (got[day] == oracle[day]).all(), day
+        # Shard map learned both owners from gossip.
+        assert sorted(filter(None, agg.shard_map.to_dict()["owners"])) \
+            == ["w0", "w1"]
+    finally:
+        for pipe, _, _ in pipes:
+            pipe.cleanup()
+        agg.stop()
+
+
+def test_dead_peer_chain_recovery(tmp_path):
+    """A worker goes silent after making state durable: the aggregator
+    declares it dead, orphans its shard at a bumped map version, and
+    folds its on-disk base+delta chain so the global view keeps the
+    peer's durable events."""
+    broker = MemoryBroker()
+    roster, _ = generate_frames(0, 1, roster_size=4_000,
+                                num_lectures=4, seed=5)
+    pipes = _federated_pipes(broker, tmp_path, 2, roster)
+    agg = Aggregator(client=MemoryClient(broker), topic="fed-gossip",
+                     num_shards=2, dead_after_s=0.4, precision=14)
+    try:
+        total = 0
+        for s, (pipe, client, mine) in enumerate(pipes):
+            rng = np.random.default_rng(40 + s)
+            prod = client.create_producer(pipe.config.pulsar_topic)
+            for _ in range(3):
+                prod.send(frame_from_columns(synth_columns(
+                    rng, 1_024, mine, 4, 0.1, invalid_base=200_000)))
+            pipe.run(max_events=3 * 1_024, idle_timeout_s=0.5)
+            pipe.snapshot()  # durable chain
+            total += 3 * 1_024
+        # The aggregator saw NO gossip yet; drain everything now.
+        _drain(agg, min_folds=2)
+        assert agg.view.events == total
+        v0 = agg.shard_map.version
+        # Workers stop gossiping (heartbeats disabled); after the
+        # silence budget both are declared dead and their chains are
+        # recovered — the merged view must not regress.
+        time.sleep(0.5)
+        dead = agg.check_liveness()
+        assert sorted(dead) == ["w0", "w1"]
+        assert agg.shard_map.version > v0
+        assert agg.shard_map.owner_of(0) is None
+        assert sorted(agg.recovered_chains) == ["w0", "w1"]
+        assert agg.view.events == total  # chain == gossiped state
+        stats = agg.stats()
+        assert not stats["workers"]["w0"]["up"]
+        eng = QueryEngine(agg.mirror)
+        assert eng.bf_exists(roster).all()
+    finally:
+        for pipe, _, _ in pipes:
+            pipe.cleanup()
+        agg.stop()
+
+
+def test_takeover_incarnation_supersedes(tmp_path):
+    """A takeover worker (same id, restored chain, higher incarnation)
+    supersedes the dead peer's counters; the dead peer's late frames
+    are detected stale and never double-counted."""
+    broker = MemoryBroker()
+    roster, _ = generate_frames(0, 1, roster_size=4_000,
+                                num_lectures=4, seed=6)
+    mine = roster[shard_of_keys(roster, 2) == 0]
+
+    def mkpipe():
+        cfg = Config(
+            bloom_filter_capacity=20_000, transport_backend="memory",
+            pulsar_topic="events.s0",
+            snapshot_dir=str(tmp_path / "w0"),
+            snapshot_every_batches=2, fed_worker="w0", fed_shard=0,
+            fed_shards=2, fed_gossip_topic="fed-gossip",
+            fed_heartbeat_s=0.0).validate()
+        client = MemoryClient(broker)
+        return FusedPipeline(cfg, client=client, num_banks=8), client
+
+    agg = Aggregator(client=MemoryClient(broker), topic="fed-gossip",
+                     num_shards=2, dead_after_s=30.0, precision=14)
+    pipe, client = mkpipe()
+    rng = np.random.default_rng(7)
+    prod = client.create_producer("events.s0")
+    for _ in range(2):
+        prod.send(frame_from_columns(synth_columns(
+            rng, 1_024, mine, 4, 0.1, invalid_base=200_000)))
+    pipe.run(max_events=2_048, idle_timeout_s=0.5)
+    pipe.snapshot()
+    late = None
+    # Capture a "late" frame from the first incarnation before death:
+    # re-publishing it later must not double-count.
+    gos = pipe._fed
+    late = gos._encode("heartbeat", 999_999)  # inflated counter claim
+    pipe.cleanup()
+
+    # Takeover: same worker id + snapshot dir; restore runs in the
+    # constructor and publishes the chain state under the NEW
+    # incarnation, with the restored total folded into every
+    # subsequent durable/published count (_events_total).
+    pipe2, client2 = mkpipe()
+    try:
+        # metrics.events is per-process; the chain-restored total
+        # rides _events_restored so manifests/epochs/gossip stay
+        # cumulative across the failover.
+        assert pipe2.metrics.events == 0
+        assert pipe2._events_restored == 2_048
+        assert pipe2._events_total == 2_048
+        prod2 = client2.create_producer("events.s0")
+        prod2.send(frame_from_columns(synth_columns(
+            rng, 1_024, mine, 4, 0.1, invalid_base=200_000)))
+        pipe2.run(max_events=1_024, idle_timeout_s=0.5)
+        pipe2.snapshot()
+        pipe2.fed_flush()
+        _drain(agg, min_folds=2)
+        assert agg.view.events == 3_072
+        inc2 = agg.view.workers["w0"].incarnation
+        assert inc2 == pipe2._fed.incarnation
+        # Replay the old incarnation's late frame: stale, no recount.
+        agg.fold_frame(decode_frame(late))
+        assert agg.view.stale_frames >= 1
+        assert agg.view.events == 3_072
+        assert agg.view.workers["w0"].incarnation == inc2
+    finally:
+        pipe2.cleanup()
+        agg.stop()
+
+
+def test_gossip_failure_defers_to_full_frame(tmp_path):
+    """A failed gossip publish must not fail the fence; the next
+    successful publish upgrades to a full frame (banks the aggregator
+    may have missed are re-asserted)."""
+    broker = MemoryBroker()
+    roster, _ = generate_frames(0, 1, roster_size=3_000,
+                                num_lectures=4, seed=8)
+    agg = Aggregator(client=MemoryClient(broker), topic="fed-gossip",
+                     num_shards=1, dead_after_s=30.0, precision=14)
+    (pipe, client, mine), = _federated_pipes(
+        broker, tmp_path, 1, roster)
+    try:
+        rng = np.random.default_rng(9)
+        prod = client.create_producer(pipe.config.pulsar_topic)
+        prod.send(frame_from_columns(synth_columns(
+            rng, 1_024, mine, 4, 0.1, invalid_base=200_000)))
+        # Break the producer under the gossip publisher.
+        real_send = pipe._fed._producer.send
+        pipe._fed._producer.send = _raise
+        pipe.run(max_events=1_024, idle_timeout_s=0.5)
+        pipe.snapshot()  # fence gossip fails silently
+        assert pipe._fed.full_due
+        pipe._fed._producer.send = real_send
+        prod.send(frame_from_columns(synth_columns(
+            rng, 1_024, mine, 4, 0.1, invalid_base=200_000)))
+        pipe.run(max_events=1_024, idle_timeout_s=0.5)
+        pipe.snapshot()  # upgraded to a full frame
+        assert not pipe._fed.full_due
+        _drain(agg, min_folds=1)
+        assert agg.view.folded_fulls >= 2  # preload + upgrade
+        assert agg.view.events == 2_048
+    finally:
+        pipe.cleanup()
+        agg.stop()
+
+
+def _raise(*a, **kw):
+    raise ConnectionError("injected gossip outage")
+
+
+# -- doctor rows -------------------------------------------------------------
+
+def _fed_prom(lag_bucket_counts):
+    lines = ["# TYPE attendance_fed_merge_lag_seconds histogram"]
+    for le, c in lag_bucket_counts:
+        lines.append(
+            'attendance_fed_merge_lag_seconds_bucket{le="%s"} %d'
+            % (le, c))
+    lines += [
+        'attendance_fed_peer_up{peer="w0"} 1',
+        'attendance_fed_peer_up{peer="w1"} 0',
+        "attendance_fed_merged_deltas_total 42",
+        "attendance_fed_stale_frames_total 3",
+        "attendance_fed_takeovers_total 1",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def test_doctor_merge_lag_rows(tmp_path):
+    from attendance_tpu.obs.slo import doctor_report
+
+    prom = tmp_path / "metrics.prom"
+    prom.write_text(_fed_prom(
+        [(0.008, 90), (0.064, 99), (1.024, 100), ("+Inf", 100)]))
+    text, ok = doctor_report([str(prom)], merge_lag_ceiling=2.0)
+    assert ok
+    assert "fed merge lag p99" in text
+    assert "fed peers up at last scrape" in text and "1/2" in text
+    assert "fed shard takeovers" in text
+    # Breach: p99 sits in the (0.064, 1.024] bucket, above 0.01.
+    text, ok = doctor_report([str(prom)], merge_lag_ceiling=0.01)
+    assert not ok and "FAIL" in text
+    # Without the flag the row is informational.
+    text, ok = doctor_report([str(prom)])
+    assert ok and "fed merge lag p99" in text
+    # Ceiling set but NO lag histogram in the artifact: the gate must
+    # fail loudly, not pass vacuously (the aggregator never folded).
+    bare = tmp_path / "bare.prom"
+    bare.write_text("attendance_events_total 5\n")
+    text, ok = doctor_report([str(bare)], merge_lag_ceiling=5.0)
+    assert not ok and "fed merge lag p99" in text and "FAIL" in text
+
+
+def test_federate_cli_smoke(tmp_path, capsys):
+    """The federate verb over a memory transport: starts, folds
+    nothing, writes a stats file, exits by deadline."""
+    from attendance_tpu.cli import main
+
+    stats = tmp_path / "fed.json"
+    main(["federate", "--transport-backend", "memory",
+          "--fed-shards", "2", "--serve-seconds", "0.3",
+          "--stats-json", str(stats), "--stats-every-s", "0.1"])
+    import json
+    doc = json.loads(stats.read_text())
+    assert doc["shard_map"]["num_shards"] == 2
+    assert doc["events"] == 0 and doc["workers"] == {}
+    assert "serve_address" in doc

@@ -95,11 +95,9 @@ def main() -> int:
     prom = work / "metrics.prom"
     alerts = work / "alerts.jsonl"
 
-    broker_proc = subprocess.Popen(
-        [sys.executable, "-m",
-         "attendance_tpu.transport.socket_broker", "--port", "0"],
-        stdout=subprocess.PIPE, text=True, cwd=str(REPO))
-    addr = broker_proc.stdout.readline().strip().rsplit(" ", 1)[-1]
+    from attendance_tpu.transport.socket_broker import spawn_broker
+
+    broker_proc, addr = spawn_broker(cwd=REPO)
     worker = None
     try:
         worker = subprocess.Popen(

@@ -1,0 +1,392 @@
+"""Federation failover soak (CI gate): 3 shard workers over a REAL
+socket broker, one SIGKILLed mid-run, a takeover worker recovering its
+shard — the merged global view must equal the no-crash single-process
+oracle exactly.
+
+Choreography:
+
+1. start a socket BrokerServer subprocess; run the federation
+   aggregator IN THIS PROCESS (driver asserts against its live merged
+   view) with telemetry -> a prom artifact for the doctor gate;
+2. spawn 3 ``attendance_tpu.federation.worker`` subprocesses
+   (``--data-plane socket``: each consumes its shard topic from the
+   broker, checkpoints in delta mode, gossips every fence);
+3. publish each shard's deterministic workload, release the go-gate;
+4. SIGKILL worker w1 the moment its snapshot chain holds a delta
+   (mid-run by construction: unacked frames requeue on disconnect);
+5. gate A — the aggregator declares w1 dead within the budget, bumps
+   the shard-map version, orphans the shard, and folds w1's durable
+   base+delta chain;
+6. spawn the takeover worker (same id, same chain dir, ``--takeover``)
+   — it restores the chain, replays the quarantine, drains the
+   requeued remainder, and re-claims the shard at a higher
+   incarnation (gate B);
+7. gate C — merged view == no-crash oracle (a no-crash FEDERATED run
+   over the same shards, merged with the CRDT twins): byte-identical
+   Bloom words, per-day register equality, zero Bloom false negatives
+   over the full roster (the driver's regenerated roster IS the exact
+   shadow), and counters never BELOW the truth — sketches and the
+   store are exactly-once under replay, cumulative counters are
+   at-least-once across a SIGKILL (a kill between a barrier's
+   durability point and its group-commit ack makes the takeover
+   reprocess that interval), so the events/valid/invalid excess must
+   stay within the group-commit window and reconcile;
+8. gate D — ``doctor`` over the aggregator's prom artifact with
+   ``--merge-lag-ceiling``.
+
+Exit 0 = all gates pass. Run on CPU:
+``JAX_PLATFORMS=cpu python tools/federation_soak.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+K = 3
+GOSSIP_TOPIC = "fed-soak-gossip"
+BASE_TOPIC = "attendance-events"
+KILLED = 1  # shard/worker index that dies
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}", flush=True)
+    return 1
+
+
+def _worker_log(workdir: Path, shard: int) -> Path:
+    return workdir / f"worker-{shard}.log"
+
+
+def _spawn_worker(addr: str, workdir: Path, shard: int, n_events: int,
+                  seed: int, *, takeover: bool = False,
+                  ready: str = "", go: str = "") -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "attendance_tpu.federation.worker",
+           "--worker", f"w{shard}", "--shard", str(shard),
+           "--num-shards", str(K), "--broker", addr,
+           "--gossip-topic", GOSSIP_TOPIC,
+           "--workdir", str(workdir), "--data-plane", "socket",
+           "--num-events", str(n_events), "--seed", str(seed),
+           "--snapshot-every", "2", "--idle-timeout-s", "4"]
+    if takeover:
+        cmd.append("--takeover")
+    if ready:
+        cmd += ["--ready-file", ready]
+    if go:
+        cmd += ["--go-file", go]
+    # Output goes to a per-worker FILE (takeover appends after its
+    # predecessor), never an undrained pipe: a saturated runner's
+    # retry-warning tracebacks can fill a 64 KB pipe and deadlock the
+    # worker mid-run. The files double as triage artifacts.
+    with open(_worker_log(workdir, shard), "a") as fh:
+        return subprocess.Popen(cmd, stdout=fh,
+                                stderr=subprocess.STDOUT, text=True,
+                                cwd=str(REPO))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", default="/tmp/federation_soak")
+    ap.add_argument("--seed", type=int, default=5)
+    ap.add_argument("--frames-per-shard", type=int, default=24)
+    ap.add_argument("--dead-after-s", type=float, default=3.0,
+                    help="peer silence budget; generous by default — "
+                    "a saturated 2-core host can stall heartbeat "
+                    "delivery past 2s, and a spuriously-dead LIVE "
+                    "peer, while convergence-safe (its chain folds "
+                    "idempotently and fresh gossip revives it), makes "
+                    "the takeover gates noisy")
+    ap.add_argument("--merge-lag-ceiling", type=float, default=5.0,
+                    help="doctor merge-lag p99 gate (generous: "
+                    "shared CI runners)")
+    args = ap.parse_args()
+
+    work = Path(args.workdir)
+    work.mkdir(parents=True, exist_ok=True)
+    prom = work / "metrics.prom"
+
+    from attendance_tpu import obs
+    from attendance_tpu.config import Config
+    from attendance_tpu.federation.gossip import Aggregator
+    from attendance_tpu.federation.shard import shard_topic
+    from attendance_tpu.federation.worker import (
+        DEFAULT_BATCH, build_workload)
+    from attendance_tpu.serve.engine import QueryEngine
+    from attendance_tpu.transport.socket_broker import (
+        SocketClient, spawn_broker)
+
+    n_events = args.frames_per_shard * DEFAULT_BATCH
+    telemetry = obs.enable(Config(metrics_prom=str(prom),
+                                  metrics_interval_s=0.2))
+
+    broker_proc, addr = spawn_broker(cwd=REPO)
+    agg_client = SocketClient(addr)
+    agg = Aggregator(client=agg_client, topic=GOSSIP_TOPIC,
+                     num_shards=K, dead_after_s=args.dead_after_s,
+                     obs=telemetry).start()
+    workers: list = []
+    try:
+        go = work / "go"
+        for s in range(K):
+            ready = work / f"ready-{s}"
+            workers.append(_spawn_worker(
+                addr, work, s, n_events, args.seed,
+                ready=str(ready), go=str(go)))
+        deadline = time.time() + 300
+        for s in range(K):
+            while not (work / f"ready-{s}").exists():
+                if workers[s].poll() is not None:
+                    return _fail(f"worker w{s} died before ready:\n"
+                                 + _worker_log(work, s).read_text())
+                if time.time() > deadline:
+                    return _fail(f"worker w{s} never became ready")
+                time.sleep(0.02)
+
+        # Publish every shard's deterministic workload, then open the
+        # gate. The driver's regenerated frames double as the oracle's
+        # input below.
+        client = SocketClient(addr)
+        all_frames: dict = {}
+        roster = None
+        for s in range(K):
+            roster, _, frames = build_workload(
+                args.seed, s, K, n_events)
+            all_frames[s] = frames
+            producer = client.create_producer(
+                shard_topic(BASE_TOPIC, s))
+            for f in frames:
+                producer.send(f)
+            producer.close()
+        go.touch()
+        print(f"[soak] {K} workers live, {n_events} events/shard "
+              f"published", flush=True)
+
+        # Kill w1 the moment its chain holds a delta (durable state
+        # exists, backlog still in flight).
+        chain = work / f"chain-{KILLED}" / "CHAIN.json"
+        deadline = time.time() + 120
+        while True:
+            if chain.exists() and json.loads(
+                    chain.read_text()).get("deltas"):
+                break
+            if workers[KILLED].poll() is not None:
+                return _fail("w1 exited before the kill "
+                             "(raise --frames-per-shard)")
+            if time.time() > deadline:
+                return _fail("w1 never wrote a delta")
+            time.sleep(0.01)
+        workers[KILLED].send_signal(signal.SIGKILL)
+        workers[KILLED].wait()
+        print("[soak] SIGKILLed w1 mid-run; chain: "
+              + chain.read_text(), flush=True)
+
+        # Gate A: dead declaration + shard orphaned + chain folded.
+        deadline = time.time() + args.dead_after_s + 30
+        while True:
+            stats = agg.stats()
+            w1 = stats["workers"].get(f"w{KILLED}")
+            if (w1 is not None and not w1["up"]
+                    and f"w{KILLED}" in stats["recovered_chains"]):
+                break
+            if time.time() > deadline:
+                return _fail("aggregator never declared w1 dead / "
+                             f"recovered its chain: {stats}")
+            time.sleep(0.05)
+        map_v_dead = stats["shard_map"]["version"]
+        if stats["shard_map"]["owners"][KILLED] is not None:
+            return _fail(f"w1's shard not orphaned: {stats['shard_map']}")
+        if map_v_dead < 2:
+            return _fail("shard-map version did not bump on failover")
+        dead_incarnation = stats["workers"][f"w{KILLED}"]["incarnation"]
+        print(f"[soak] gate A: w1 dead, shard orphaned at map v"
+              f"{map_v_dead}, chain recovered "
+              f"({stats['recovered_chains']})", flush=True)
+
+        # Takeover worker: same id, same chain dir, higher incarnation.
+        takeover = _spawn_worker(addr, work, KILLED, n_events,
+                                 args.seed, takeover=True)
+        workers.append(takeover)
+
+        # Wait for every worker to finish (w0/w2 drain + exit; the
+        # takeover drains the requeued remainder).
+        deadline = time.time() + 300
+        for w in (workers[0], workers[2], takeover):
+            while w.poll() is None:
+                if time.time() > deadline:
+                    return _fail("a worker never finished")
+                time.sleep(0.1)
+        reports = {}
+        for w, shard in ((workers[0], 0), (workers[2], 2),
+                         (takeover, KILLED)):
+            out = _worker_log(work, shard).read_text().strip()
+            if w.returncode != 0:
+                return _fail(f"worker rc={w.returncode}:\n{out}")
+            # The takeover appends to the killed worker's log; the
+            # LAST report line is always the surviving run's.
+            rep = json.loads([ln for ln in out.splitlines()
+                              if ln.startswith("{")][-1])
+            reports[(rep["worker"], rep["takeover"])] = rep
+        print(f"[soak] workers done: { {k: v['events'] for k, v in reports.items()} }",
+              flush=True)
+
+        # Gate B: the takeover re-claimed the shard at a higher
+        # incarnation (its gossip marked the peer back up).
+        deadline = time.time() + 30
+        while True:
+            stats = agg.stats()
+            w1 = stats["workers"].get(f"w{KILLED}")
+            if (w1 is not None and w1["up"]
+                    and w1["incarnation"] > dead_incarnation
+                    and stats["shard_map"]["owners"][KILLED]
+                    == f"w{KILLED}"):
+                break
+            if time.time() > deadline:
+                return _fail(f"takeover never re-claimed the shard: "
+                             f"{stats}")
+            time.sleep(0.05)
+        print(f"[soak] gate B: takeover re-claimed shard {KILLED} "
+              f"(incarnation {w1['incarnation']:.3f} > "
+              f"{dead_incarnation:.3f})", flush=True)
+
+        # Drain the gossip tail synchronously, then assert.
+        agg.pause()
+        while agg.poll(timeout_ms=200) > 0:
+            pass
+
+        # Gate C: merged view == no-crash oracle. The oracle is a
+        # NO-CRASH FEDERATED run — K in-process pipelines over the
+        # same shard slices and frames, merged host-side with the CRDT
+        # twins. (A single full-population pipeline is NOT register-
+        # equivalent: its denser Bloom filter admits a different set
+        # of false-positive invalid keys into the day HLLs, so only
+        # the same topology run without the SIGKILL is the honest
+        # "what did the crash cost" baseline.)
+        import numpy as np
+
+        from attendance_tpu.federation.shard import shard_of_keys
+        from attendance_tpu.models.bloom import bloom_or_words_np
+        from attendance_tpu.models.fused import decode_counts
+        from attendance_tpu.models.hll import hll_merge_np
+        from attendance_tpu.pipeline.fast_path import FusedPipeline
+        from attendance_tpu.transport.memory_broker import (
+            MemoryBroker, MemoryClient)
+
+        oracle_by_day: dict = {}
+        owords = None
+        ovalid = oinvalid = 0
+        for s in range(K):
+            oclient = MemoryClient(MemoryBroker())
+            opipe = FusedPipeline(Config(transport_backend="memory"),
+                                  client=oclient, num_banks=16)
+            opipe.preload(roster[shard_of_keys(roster, K) == s])
+            oproducer = oclient.create_producer("attendance-events")
+            for f in all_frames[s]:
+                oproducer.send(f)
+            opipe.run(max_events=n_events, idle_timeout_s=3.0)
+            if opipe.metrics.events != n_events:
+                return _fail(f"oracle shard {s} processed "
+                             f"{opipe.metrics.events} != {n_events}")
+            words = np.asarray(opipe.state.bloom_bits)
+            owords = (words if owords is None
+                      else bloom_or_words_np(owords, words))
+            oregs = np.asarray(opipe.state.hll_regs)
+            for day, b in opipe._bank_of.items():
+                cur = oracle_by_day.get(int(day))
+                oracle_by_day[int(day)] = (
+                    oregs[b].copy() if cur is None
+                    else hll_merge_np(cur, oregs[b])[0])
+            v, i = decode_counts(np.asarray(opipe.state.counts))
+            ovalid += v
+            oinvalid += i
+            opipe.cleanup()
+
+        # Counter contract across a SIGKILL (same as the delta-crash
+        # smoke's): sketch state and the store are exactly-once
+        # (idempotent merges / last-write-wins dedup), but cumulative
+        # COUNTERS are at-least-once — a kill landing between a
+        # barrier's durability point and its group-commit ack makes
+        # the takeover reprocess (and recount) that interval's frames.
+        # Gate: never BELOW the true total (acked loss), above it by
+        # at most two group-commit intervals.
+        total = K * n_events
+        overcount = agg.view.events - total
+        ceiling = 2 * 2 * 8_192  # 2 barriers x (snapshot-every=2 x batch)
+        if overcount < 0:
+            return _fail(f"merged events {agg.view.events} < {total} "
+                         "— acked events were LOST across the "
+                         "failover")
+        if overcount > ceiling:
+            return _fail(f"merged events overcount {overcount} "
+                         f"exceeds the group-commit window ({ceiling})"
+                         " — takeover is replaying acked frames")
+        print(f"[soak] events {agg.view.events} (true {total}, "
+              f"bounded at-least-once overcount {overcount})",
+              flush=True)
+        if not (agg.view.bloom_words == owords).all():
+            return _fail("merged Bloom words differ from the no-crash "
+                         "oracle filter union")
+        got_by_day = agg.view.regs_by_day()
+        if set(got_by_day) != set(oracle_by_day):
+            return _fail(f"day sets differ: {sorted(got_by_day)} vs "
+                         f"{sorted(oracle_by_day)}")
+        for day, row in oracle_by_day.items():
+            if not (got_by_day[day] == row).all():
+                return _fail(f"registers for day {day} differ from "
+                             "the oracle")
+        gvalid, ginvalid = decode_counts(agg.view.counts_array())
+        if gvalid < ovalid or ginvalid < oinvalid:
+            return _fail(f"valid/invalid counters regressed: "
+                         f"({gvalid}, {ginvalid}) vs oracle "
+                         f"({ovalid}, {oinvalid})")
+        if (gvalid - ovalid) + (ginvalid - oinvalid) != overcount:
+            return _fail(
+                f"valid/invalid excess (+{gvalid - ovalid}, "
+                f"+{ginvalid - oinvalid}) does not reconcile with the "
+                f"events overcount {overcount}")
+        # Exact-shadow membership audit: zero false negatives over the
+        # full (driver-regenerated) roster.
+        engine = QueryEngine(agg.mirror)
+        misses = int((~engine.bf_exists(roster)).sum())
+        if misses:
+            return _fail(f"{misses} Bloom false negatives over the "
+                         "federated view")
+        print(f"[soak] gate C: merged state == oracle ({total} events,"
+              f" {len(got_by_day)} days, zero false negatives)",
+              flush=True)
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        try:
+            agg.stop()
+            agg_client.close()
+        except Exception:
+            pass
+        broker_proc.kill()
+        broker_proc.wait()
+        obs.disable()  # writes the final exposition block
+
+    # Gate D: doctor over the aggregator's prom artifact.
+    doctor = subprocess.run(
+        [sys.executable, "-m", "attendance_tpu.cli", "doctor",
+         str(prom), "--merge-lag-ceiling",
+         str(args.merge_lag_ceiling)], cwd=str(REPO))
+    if doctor.returncode != 0:
+        return _fail(f"doctor exited {doctor.returncode}")
+    print("PASS: federation soak (dead-peer takeover, oracle-equal "
+          "merged state, zero false negatives, doctor gates)",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
