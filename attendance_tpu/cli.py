@@ -330,6 +330,8 @@ def cmd_serve(args) -> None:
     if not config.snapshot_dir:
         logger.error("serve needs --snapshot-dir (the chain to read)")
         sys.exit(2)
+    if config.fleet_push and not config.fleet_role:
+        config.fleet_role = "serve"
     telemetry = obs.ensure(config)
     try:
         source = ChainEpochSource(config.snapshot_dir,
@@ -386,7 +388,30 @@ def cmd_federate(args) -> None:
     from attendance_tpu.serve.rpc import QueryServer
 
     config = config_from_args(args)
+    collector = None
+    if config.fleet_port:
+        # The aggregator is the natural fleet-collector host: it
+        # already outlives the workers and serves the merged view.
+        # Created BEFORE the telemetry bundle so the aggregator's own
+        # pusher can default to the in-process collector — the pane
+        # of glass must include the aggregator role itself.
+        from attendance_tpu.obs.fleet import FleetCollector
+
+        collector = FleetCollector(
+            directory=config.fleet_dir,
+            port=0 if config.fleet_port < 0 else config.fleet_port,
+            ).start()
+        config.fleet_push = config.fleet_push or collector.address
+        print(f"fleet collector on {collector.address}"
+              + (f" (artifacts -> {config.fleet_dir})"
+                 if config.fleet_dir else ""), flush=True)
+    if config.fleet_push and not config.fleet_role:
+        config.fleet_role = "aggregator"
     telemetry = obs.ensure(config)
+    if collector is not None and telemetry is not None:
+        collector.bind_obs(telemetry)
+        if telemetry._server is not None:
+            collector.attach(telemetry._server)
     agg = Aggregator(config, obs=telemetry).start()
     engine = QueryEngine(
         agg.mirror, obs=telemetry, batch_max=config.query_batch_max,
@@ -426,9 +451,58 @@ def cmd_federate(args) -> None:
             agg.stop()
             write_stats()
         finally:
+            if (collector is not None and telemetry is not None
+                    and telemetry._server is not None):
+                collector.detach(telemetry._server)
+            # Stop telemetry BEFORE the collector: Telemetry.stop()
+            # performs the pusher's final drain push, which must land
+            # while the collector still accepts — otherwise a run
+            # shorter than the push interval flushes FLEET.json
+            # without the aggregator's own row.
+            obs.disable()
+            if collector is not None:
+                collector.stop()  # flushes the fleet artifacts
             server.stop()
     _json.dump(agg.stats(), sys.stdout)
     print(flush=True)
+
+
+def _follow_file(path: str, last: int, interval_s: float,
+                 max_rounds=None) -> int:
+    """Tail a telemetry artifact: re-render whenever the file grows or
+    is atomically replaced (size+mtime change). Returns the number of
+    renders. ``max_rounds`` bounds the loop for tests; the CLI runs
+    until interrupted."""
+    import os
+    import time as _time
+
+    from attendance_tpu.obs.exposition import format_file
+
+    renders = 0
+    last_sig = None
+    rounds = 0
+    while max_rounds is None or rounds < max_rounds:
+        rounds += 1
+        try:
+            st = os.stat(path)
+            sig = (st.st_size, st.st_mtime_ns)
+        except FileNotFoundError:
+            sig = None
+        if sig is not None and sig != last_sig:
+            last_sig = sig
+            try:
+                body = format_file(path, last=last)
+            except Exception as e:
+                body = f"(unreadable mid-write: {e})"
+            # Clear + home, then the fresh table: a live prom file
+            # appends a block per interval, so this reads like `top`.
+            print("\x1b[2J\x1b[H" + f"== {path} @ "
+                  f"{_time.strftime('%H:%M:%S')} ==\n" + body,
+                  flush=True)
+            renders += 1
+        if max_rounds is None or rounds < max_rounds:
+            _time.sleep(interval_s)
+    return renders
 
 
 def cmd_telemetry(args) -> None:
@@ -436,11 +510,20 @@ def cmd_telemetry(args) -> None:
     (``kill -USR1`` / crash / --flight-path), a Prometheus exposition
     file (--metrics-prom; the last scrape block is shown), or a
     Chrome-trace export (--trace-out; per-trace span trees with
-    durations). The format is sniffed from the file content."""
+    durations). The format is sniffed from the file content.
+    ``--follow`` tails a LIVE file instead: the table re-renders every
+    time the reporter appends a scrape block (or the trace/flight file
+    is atomically replaced), until interrupted."""
     import sys
 
     from attendance_tpu.obs.exposition import format_file
 
+    if args.follow:
+        try:
+            _follow_file(args.path, args.last, args.interval_s)
+        except KeyboardInterrupt:
+            pass
+        return
     try:
         print(format_file(args.path, last=args.last))
     except FileNotFoundError:
@@ -453,6 +536,100 @@ def cmd_telemetry(args) -> None:
         logger.error("unreadable telemetry artifact %s: %s",
                      args.path, e)
         sys.exit(2)
+
+
+def _fleet_status(args) -> dict:
+    """One status snapshot: live from the collector's /fleet/status
+    HTTP route (--http), or offline from a collected artifact dir's
+    FLEET.json (--dir)."""
+    import json as _json
+    import urllib.request
+
+    if args.dir:
+        from pathlib import Path
+
+        from attendance_tpu.obs.fleet import STATUS_FILE
+
+        return _json.loads((Path(args.dir) / STATUS_FILE).read_text())
+    url = f"http://{args.http}/fleet/status"
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return _json.loads(resp.read())
+
+
+def _fleet_table(doc: dict) -> str:
+    from attendance_tpu.obs.exposition import _table
+
+    rows = []
+    for key in sorted(doc.get("instances", {})):
+        inst = doc["instances"][key]
+        rows.append([
+            key,
+            f"{inst.get('age_s', 0.0):.1f}s",
+            str(inst.get("pushes", 0)),
+            str(inst.get("spans", 0)),
+            str(inst.get("events", "-")),
+            str(inst.get("series", "-")),
+            str(inst.get("merge_lag_p99_s", "-")),
+            str(inst.get("read_staleness_s", "-")),
+            str(inst.get("slo_firing", 0)),
+        ])
+    return _table(rows, ["role@instance", "age", "pushes", "spans",
+                         "events", "series", "lag_p99", "staleness",
+                         "firing"])
+
+
+def cmd_fleet(args) -> None:
+    """Fleet dashboard over a live collector (or a collected artifact
+    dir): one row per pushing role@instance — push liveness, span and
+    series volume, headline counters, merge lag, staleness, firing
+    alerts. Default is a top-style live loop; ``--once`` prints one
+    table; ``--snapshot-json PATH`` writes the raw status document
+    (``-`` = stdout) and exits — the machine-readable twin the soak
+    and tests consume."""
+    import json as _json
+    import sys
+    import time as _time
+
+    if not args.http and not args.dir:
+        logger.error("fleet needs --http HOST:PORT (live collector) "
+                     "or --dir FLEET_DIR (collected artifacts)")
+        sys.exit(2)
+    try:
+        doc = _fleet_status(args)
+    except Exception as e:
+        logger.error("no fleet status from %s: %s",
+                     args.http or args.dir, e)
+        sys.exit(2)
+    if args.snapshot_json:
+        out = _json.dumps(doc, indent=2)
+        if args.snapshot_json == "-":
+            print(out)
+        else:
+            with open(args.snapshot_json, "w") as f:
+                f.write(out + "\n")
+            print(f"fleet snapshot -> {args.snapshot_json}")
+        return
+    if args.once or args.dir:
+        print(_fleet_table(doc))
+        return
+    stale = ""
+    try:
+        while True:
+            print("\x1b[2J\x1b[H"
+                  + f"fleet @ {_time.strftime('%H:%M:%S')} "
+                  f"({args.http}){stale}\n" + _fleet_table(doc),
+                  flush=True)
+            _time.sleep(args.interval_s)
+            try:
+                doc = _fleet_status(args)
+                stale = ""
+            except Exception as e:
+                # A restarting collector or one slow scrape must not
+                # kill the dashboard: keep rendering the last good
+                # snapshot, marked stale, and retry next interval.
+                stale = f"  [stale: {e}]"
+    except KeyboardInterrupt:
+        pass
 
 
 def cmd_doctor(args) -> None:
@@ -490,6 +667,36 @@ def cmd_doctor(args) -> None:
               + (" (entries purged)" if args.purge_replayed else ""))
         if not args.artifacts:
             return
+    if args.fleet:
+        # Fleet mode: merge every per-role artifact the collector
+        # gathered into ONE verdict table (per-role rows + fleet-wide
+        # merge-lag/staleness gates). Positional artifacts may ride
+        # along and are judged by the normal report below.
+        from attendance_tpu.obs.slo import doctor_fleet_report
+
+        try:
+            text, ok = doctor_fleet_report(
+                args.fleet, fpr_ceiling=args.fpr_ceiling,
+                hll_error_ceiling=args.hll_error_ceiling,
+                snapshot_stall_ceiling=args.snapshot_stall_ceiling,
+                max_reconnects=args.max_reconnects,
+                lane_skew_ceiling=args.lane_skew_ceiling,
+                query_p99_ceiling=args.query_p99_ceiling,
+                staleness_ceiling=args.staleness_ceiling,
+                merge_lag_ceiling=args.merge_lag_ceiling)
+        except FileNotFoundError as e:
+            logger.error("no such fleet artifact dir: %s", e)
+            sys.exit(2)
+        except Exception as e:
+            logger.error("unreadable fleet artifacts: %s", e)
+            sys.exit(2)
+        print(text)
+        if not args.artifacts and not args.quarantine:
+            sys.exit(0 if ok else 1)
+        elif not ok:
+            # Fall through to the artifact report, but remember the
+            # fleet breach for the combined exit code.
+            args._fleet_failed = True
     if not args.artifacts and not args.quarantine:
         logger.error("doctor needs artifacts and/or --quarantine DIR")
         sys.exit(2)
@@ -511,7 +718,7 @@ def cmd_doctor(args) -> None:
         logger.error("unreadable artifacts: %s", e)
         sys.exit(2)
     print(text)
-    if not ok:
+    if not ok or getattr(args, "_fleet_failed", False):
         sys.exit(1)
 
 
@@ -652,7 +859,37 @@ def main(argv=None) -> None:
                        "Chrome-trace JSON file")
     p_tel.add_argument("--last", type=int, default=32,
                        help="flight records / traces shown (most recent)")
+    p_tel.add_argument("--follow", action="store_true",
+                       help="tail a LIVE artifact: re-render the "
+                       "table every time the file grows (a reporter "
+                       "appending scrape blocks) or is replaced, "
+                       "until interrupted")
+    p_tel.add_argument("--interval-s", type=float, default=0.5,
+                       help="poll cadence for --follow")
     p_tel.set_defaults(fn=cmd_telemetry)
+
+    p_fleet = sub.add_parser(
+        "fleet", help="fleet dashboard: per-role push liveness, "
+        "headline counters, merge lag, staleness, firing alerts — "
+        "live from a collector's /fleet/status (--http) or offline "
+        "from a collected artifact dir (--dir); --snapshot-json "
+        "writes the raw status document")
+    p_fleet.add_argument("--http", default="",
+                         help="HOST:PORT of the collector process's "
+                         "--metrics-port endpoint (the /fleet/* "
+                         "routes)")
+    p_fleet.add_argument("--dir", default="",
+                         help="read a collected --fleet-dir offline "
+                         "instead (FLEET.json)")
+    p_fleet.add_argument("--interval-s", type=float, default=2.0,
+                         help="live refresh cadence")
+    p_fleet.add_argument("--once", action="store_true",
+                         help="print one table and exit")
+    p_fleet.add_argument("--snapshot-json", default="",
+                         metavar="PATH",
+                         help="write one raw status JSON snapshot "
+                         "('-' = stdout) and exit")
+    p_fleet.set_defaults(fn=cmd_fleet)
 
     p_doc = sub.add_parser(
         "doctor", help="offline SLO verdict over run artifacts "
@@ -695,6 +932,11 @@ def main(argv=None) -> None:
                        "(fence -> folded-into-global-view seconds) "
                        "recovered from the prom histogram; omitted = "
                        "informational row")
+    p_doc.add_argument("--fleet", default="", metavar="DIR",
+                       help="judge a fleet collector's artifact dir "
+                       "(--fleet-dir): every <role>@<instance>.prom "
+                       "gets per-role rows, plus fleet-wide merge-lag"
+                       "/staleness gates over the merged data")
     p_doc.add_argument("--quarantine", default="",
                        help="list this on-disk dead-letter quarantine "
                        "in the verdict table")

@@ -267,6 +267,33 @@ class Config:
     fed_gossip_broker: str = ""
     fed_heartbeat_s: float = 2.0
     fed_dead_after_s: float = 10.0
+    # Fleet observability plane (obs/fleet.py). fleet_push names a
+    # FleetCollector HOST:PORT ("" = off): when set, this process's
+    # telemetry bundle starts a background pusher shipping its
+    # registry snapshot + bounded span batches there every
+    # fleet_push_interval_s (the pusher rides the transport
+    # retry/reconnect/chaos seams at site "fleet.push"; a dead
+    # collector costs log noise, never throughput or correctness).
+    # fleet_role/fleet_instance label this process in the merged
+    # registry and the stitched trace ("" = derived: the CLI verbs set
+    # their role, instance falls back to fed_worker or the pid).
+    fleet_push: str = ""
+    fleet_role: str = ""
+    fleet_instance: str = ""
+    fleet_push_interval_s: float = 2.0
+    # Collector side: fleet_port != 0 runs a FleetCollector in this
+    # process (-1 = ephemeral; the `federate` verb is the natural
+    # host), re-exposing the merged registry under /fleet/* on the
+    # --metrics-port endpoint; fleet_dir persists the collected
+    # per-role prom files + stitched trace for `doctor --fleet` / CI.
+    fleet_port: int = 0
+    fleet_dir: str = ""
+    # Label-cardinality guard: max distinct label sets per metric name
+    # before new sets fold into an unexported per-family sink (ERROR
+    # logged once). The per-day audit/read gauges grow one series per
+    # lecture day — unbounded on a long multi-day run without a cap.
+    # <= 0 disables the guard.
+    metric_series_max: int = 1024
     # Total retry budget for one logical broker RPC over the socket
     # transport: transient failures reconnect + retry with jittered
     # exponential backoff inside this window, then surface ONE
@@ -361,6 +388,12 @@ class Config:
             raise ValueError(
                 "read_staleness_ceiling_s must be >= 0 (0 = no "
                 "staleness objective)")
+        if self.fleet_push_interval_s <= 0:
+            raise ValueError("fleet_push_interval_s must be positive")
+        if not (-1 <= self.fleet_port <= 65535):
+            raise ValueError(
+                f"fleet_port out of range: {self.fleet_port} "
+                "(0 = off, -1 = ephemeral)")
         if self.persist_breaker_failures <= 0:
             raise ValueError("persist_breaker_failures must be positive")
         if self.persist_breaker_cooldown_s <= 0:
@@ -506,6 +539,33 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    default=d.fed_dead_after_s,
                    help="silence budget before the aggregator "
                    "declares a peer dead and recovers its shard")
+    p.add_argument("--fleet-push", default=d.fleet_push,
+                   help="push this process's telemetry (registry "
+                   "snapshot + span batches) to a fleet collector at "
+                   "HOST:PORT every --fleet-push-interval-s "
+                   "(empty = off)")
+    p.add_argument("--fleet-role", default=d.fleet_role,
+                   help="role label for fleet pushes (default: the "
+                   "CLI verb's role, else 'process')")
+    p.add_argument("--fleet-instance", default=d.fleet_instance,
+                   help="instance label for fleet pushes (default: "
+                   "--fed-worker or pid<PID>)")
+    p.add_argument("--fleet-push-interval-s", type=float,
+                   default=d.fleet_push_interval_s,
+                   help="fleet push cadence (seconds)")
+    p.add_argument("--fleet-port", type=int, default=d.fleet_port,
+                   help="run a fleet collector in this process on "
+                   "this TCP port (0 = off, -1 = ephemeral); merged "
+                   "views mount under /fleet/* on --metrics-port")
+    p.add_argument("--fleet-dir", default=d.fleet_dir,
+                   help="persist collected fleet artifacts (per-role "
+                   "prom files, stitched trace, status snapshot) "
+                   "here — the `doctor --fleet` input")
+    p.add_argument("--metric-series-max", type=int,
+                   default=d.metric_series_max,
+                   help="label-cardinality cap per metric name "
+                   "(<= 0 = unlimited); overflow folds into an "
+                   "unexported sink and logs once at ERROR")
     p.add_argument("--retry-budget-s", type=float,
                    default=d.retry_budget_s,
                    help="total reconnect+retry window per broker RPC "
@@ -605,6 +665,13 @@ def config_from_args(args: argparse.Namespace) -> Config:
         fed_gossip_broker=args.fed_gossip_broker,
         fed_heartbeat_s=args.fed_heartbeat_s,
         fed_dead_after_s=args.fed_dead_after_s,
+        fleet_push=args.fleet_push,
+        fleet_role=args.fleet_role,
+        fleet_instance=args.fleet_instance,
+        fleet_push_interval_s=args.fleet_push_interval_s,
+        fleet_port=args.fleet_port,
+        fleet_dir=args.fleet_dir,
+        metric_series_max=args.metric_series_max,
         retry_budget_s=args.retry_budget_s,
         serve_port=args.serve_port,
         query_batch_max=args.query_batch_max,

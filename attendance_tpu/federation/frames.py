@@ -28,6 +28,14 @@ for the rows carried. Bloom-OR and HLL register-max are commutative,
 associative, and idempotent, so replayed, duplicated, or reordered
 frames are harmless by construction; cumulative counters are folded
 newest-(incarnation, seq)-wins.
+
+The header also carries a ``traceparent`` (the obs/tracing compact
+context) naming the worker's ``fence_publish`` span, so the
+aggregator's ``fed_merge`` span parents under the originating fence
+across the process boundary — federated traces stitch into one tree in
+the fleet collector's Perfetto export. Frames from older workers lack
+the key entirely; the aggregator tolerates that loudly (warn once per
+worker) rather than failing the fold.
 """
 
 from __future__ import annotations
@@ -68,7 +76,7 @@ def encode_frame(*, worker: str, kind: str, incarnation: float,
                  bank_of: Optional[Dict[int, int]] = None,
                  m_bits: int = 0, k: int = 0, precision: int = 14,
                  num_banks: int = 0, roster_size: int = 0,
-                 snapshot_dir: str = "",
+                 snapshot_dir: str = "", traceparent: str = "",
                  arrays: Optional[Dict[str, np.ndarray]] = None
                  ) -> bytes:
     """Serialize one merge frame. ``arrays`` by kind:
@@ -89,6 +97,12 @@ def encode_frame(*, worker: str, kind: str, incarnation: float,
         "m_bits": int(m_bits), "k": int(k),
         "precision": int(precision), "num_banks": int(num_banks),
         "snapshot_dir": snapshot_dir,
+        # Cross-process trace context ("" = publisher not tracing).
+        # The KEY is always present on current frames: an aggregator
+        # distinguishes "tracing off" (empty) from "older worker that
+        # predates stitching" (key absent) and tolerates both — the
+        # latter loudly, once per worker.
+        "traceparent": traceparent,
         # day->bank as a JSON-safe {str(day): bank} map, like the
         # snapshot manifests spell it.
         "bank_of": {str(d): int(b)

@@ -122,6 +122,7 @@ class FenceGossip:
         self._hb_thread: Optional[threading.Thread] = None
         self._last_events = 0
         self._c_frames = self._c_failures = None
+        self._tracer = obs.tracer if obs is not None else None
         if obs is not None:
             self._c_frames = {
                 kind: obs.registry.counter(
@@ -170,15 +171,31 @@ class FenceGossip:
     def _encode(self, kind: str, events: int, *, bank_of=None,
                 roster_size: int = 0, num_banks: int = 0,
                 arrays=None) -> bytes:
-        return encode_frame(
+        seq = next(self._seq)
+        tp = ""
+        span = None
+        if self._tracer is not None and kind != "heartbeat":
+            # The fence-publish span IS the cross-process parent: its
+            # context ships in the frame header so the aggregator's
+            # fed_merge span nests under it in the stitched trace.
+            span = self._tracer.start_span(
+                "fence_publish",
+                args={"kind": kind, "worker": self.worker,
+                      "shard": self.shard, "seq": seq})
+            from attendance_tpu.obs.tracing import format_ctx
+            tp = format_ctx(span.context(seq))
+        data = encode_frame(
             worker=self.worker, kind=kind,
-            incarnation=self.incarnation, seq=next(self._seq),
+            incarnation=self.incarnation, seq=seq,
             shard=self.shard, fence_ts=time.time(),
             events=int(events),
             bank_of=bank_of, m_bits=self.m_bits, k=self.k,
             precision=self.precision, num_banks=num_banks,
             roster_size=roster_size, snapshot_dir=self.snapshot_dir,
-            arrays=arrays)
+            traceparent=tp, arrays=arrays)
+        if span is not None:
+            self._tracer.end_span(span)
+        return data
 
     def publish_full(self, bloom_words, regs, counts,
                      bank_of: Dict[int, int], events: int,
@@ -257,6 +274,7 @@ class Aggregator:
         self.consumer = self._client.subscribe(self.topic,
                                                GOSSIP_SUBSCRIPTION)
         self._down: set = set()
+        self._no_traceparent_warned: set = set()
         self.recovered_chains: Dict[str, int] = {}
         self.geometry_rejects = 0
         self._stop = threading.Event()
@@ -310,6 +328,18 @@ class Aggregator:
         t0 = time.perf_counter()
         info = self.view.fold(frame, now=now)
         worker = frame.worker
+        if ("traceparent" not in frame.header
+                and frame.kind != "heartbeat"
+                and worker not in self._no_traceparent_warned):
+            # An older worker predating trace stitching: fold its
+            # state normally, but say ONCE per worker that its fences
+            # will appear as orphaned roots in the stitched export.
+            self._no_traceparent_warned.add(worker)
+            logger.warning(
+                "gossip frames from %s carry no traceparent field "
+                "(older worker build?) — folding normally, but its "
+                "fences cannot parent fed_merge spans in the "
+                "stitched trace", worker)
         ledger = self.view.workers[worker]
         # The aggregator's own chain-recovery fold (header marker
         # "recovered") re-asserts a dead peer's STATE, never its
@@ -333,9 +363,21 @@ class Aggregator:
                 self._h_lag.observe(info["lag_s"])
                 self._c_deltas.inc()
             if self._tracer is not None:
+                from attendance_tpu.obs.tracing import parse_ctx
+                # Continue the trace the worker's fence_publish span
+                # started (traceparent rode the gossip header): the
+                # fed_merge span parents under the originating fence,
+                # so the stitched fleet export reads fence -> merge as
+                # one tree across processes. Untraced/older workers
+                # degrade to a fresh root, exactly like the broker
+                # consumers do.
+                ctx = parse_ctx(frame.header.get("traceparent"))
                 self._tracer.add_span(
                     "fed_merge", t0, time.perf_counter(),
-                    trace_id=self._tracer.new_id(),
+                    trace_id=(ctx.trace_id if ctx is not None
+                              else self._tracer.new_id()),
+                    parent_id=(ctx.span_id if ctx is not None
+                               else None),
                     role=self._TRACE_ROLE,
                     args={"worker": worker, "kind": frame.kind,
                           "lag_s": round(info["lag_s"], 6)})
@@ -456,6 +498,7 @@ class Aggregator:
                 precision=int(man["precision"]),
                 num_banks=state["regs"].shape[0],
                 snapshot_dir=str(snapshot_dir), recovered=True,
+                traceparent="",  # synthetic fold, not an old worker
                 bank_of={int(d): int(b)
                          for d, b in state["bank_of"].items()}),
             arrays=dict(

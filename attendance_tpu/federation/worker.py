@@ -88,7 +88,8 @@ def make_worker_config(worker: str, shard: int, num_shards: int,
                        broker: str, workdir, *, base_topic: str,
                        data_plane: str = "socket",
                        snapshot_every: int = 4, gossip_topic: str = "",
-                       metrics_prom: str = "", trace_out: str = ""):
+                       metrics_prom: str = "", trace_out: str = "",
+                       fleet_push: str = ""):
     from attendance_tpu.config import Config
 
     workdir = Path(workdir)
@@ -104,7 +105,12 @@ def make_worker_config(worker: str, shard: int, num_shards: int,
         quarantine_dir=str(workdir / f"quarantine-{shard}"),
         fed_worker=worker, fed_shard=shard, fed_shards=num_shards,
         fed_gossip_broker=broker,
-        metrics_prom=metrics_prom, trace_out=trace_out, **kw,
+        metrics_prom=metrics_prom, trace_out=trace_out,
+        # Fleet plane: the worker pushes its registry + span batches
+        # to the collector so the aggregator-side pane of glass (and
+        # doctor --fleet) sees every shard, not just the fold side.
+        fleet_push=fleet_push, fleet_role="worker",
+        fleet_instance=worker, **kw,
     ).validate()
 
 
@@ -118,7 +124,8 @@ def run_worker(args) -> dict:
         data_plane=args.data_plane,
         snapshot_every=args.snapshot_every,
         gossip_topic=args.gossip_topic,
-        metrics_prom=args.metrics_prom)
+        metrics_prom=args.metrics_prom,
+        fleet_push=args.fleet_push)
     full, mine, frames = build_workload(
         args.seed, args.shard, args.num_shards, args.num_events,
         roster_size=args.roster_size, batch=args.batch)
@@ -224,6 +231,9 @@ def main(argv=None) -> None:
     p.add_argument("--ready-file", default="")
     p.add_argument("--go-file", default="")
     p.add_argument("--metrics-prom", default="")
+    p.add_argument("--fleet-push", default="",
+                   help="fleet collector HOST:PORT to push telemetry "
+                   "to (role=worker, instance=--worker)")
     args = p.parse_args(argv)
     report = run_worker(args)
     print(json.dumps(report), flush=True)

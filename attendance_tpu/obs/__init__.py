@@ -100,6 +100,11 @@ def _atexit_flush() -> None:
         except Exception:
             logger.exception("atexit metrics block write failed")
     t.flush_trace("atexit")
+    if t._fleet is not None:
+        try:
+            t._fleet.push_now()  # last snapshot reaches the collector
+        except Exception:
+            logger.exception("atexit fleet push failed")
 
 
 def _install_atexit_flush() -> None:
@@ -127,7 +132,8 @@ def enabled_in(config) -> bool:
                 or getattr(config, "trace_out", "")
                 or getattr(config, "audit_sample", 0.0)
                 or getattr(config, "alert_log", "")
-                or getattr(config, "slo", None))
+                or getattr(config, "slo", None)
+                or getattr(config, "fleet_push", ""))
 
 
 class Telemetry:
@@ -139,8 +145,12 @@ class Telemetry:
                  flight_path: str = DEFAULT_FLIGHT_PATH,
                  trace_out: str = "", audit_sample: float = 0.0,
                  alert_log: str = "", slo_specs=(),
-                 slo_fast_s: float = 60.0, slo_slow_s: float = 300.0):
-        self.registry = Registry()
+                 slo_fast_s: float = 60.0, slo_slow_s: float = 300.0,
+                 fleet_push: str = "", fleet_role: str = "",
+                 fleet_instance: str = "",
+                 fleet_push_interval_s: float = 2.0,
+                 metric_series_max: int = 1024):
+        self.registry = Registry(max_series=metric_series_max)
         self.flight: Optional[FlightRecorder] = (
             FlightRecorder(flight_recorder) if flight_recorder > 0
             else None)
@@ -148,9 +158,17 @@ class Telemetry:
         # Span tracer (obs/tracing.py): instrumented sites capture
         # `telemetry.tracer` once and branch on `is not None` — a
         # metrics-only run (trace_out unset) pays nothing for tracing.
-        self.tracer: Optional[Tracer] = (Tracer() if trace_out
-                                         else None)
+        # A fleet-pushing process traces even without a local
+        # --trace-out: its spans ship to the collector's stitched
+        # export instead of (or as well as) a local file.
+        self.tracer: Optional[Tracer] = (
+            Tracer() if (trace_out or fleet_push) else None)
         self.trace_path = trace_out
+        self._fleet_push = fleet_push
+        self._fleet_role = fleet_role or "process"
+        self._fleet_instance = fleet_instance
+        self._fleet_interval = fleet_push_interval_s
+        self._fleet: Optional[object] = None
         # Accuracy auditor (obs/audit.py): same capture-once handle
         # discipline — sketch stores and the fused pipeline hold
         # `telemetry.auditor` and branch on `is not None`.
@@ -200,6 +218,15 @@ class Telemetry:
                                                  self.flight_path)
         if self.slo is not None:
             self.slo.start()
+        if self._fleet_push:
+            from attendance_tpu.obs.fleet import (
+                FleetPusher, default_instance)
+            self._fleet = FleetPusher(
+                self.registry, self.tracer, self._fleet_push,
+                role=self._fleet_role,
+                instance=(self._fleet_instance
+                          or default_instance()),
+                interval_s=self._fleet_interval).start()
         if (self.tracer is not None or self._reporter is not None
                 or self.slo is not None):
             # Backstop for CLI runs that never reach a run-loop flush
@@ -216,6 +243,12 @@ class Telemetry:
 
     def stop(self) -> None:
         self.flush_trace("telemetry-stop")
+        if self._fleet is not None:
+            # Final push (incl. any spans recorded above) so a run
+            # shorter than the push interval still reaches the
+            # collector — the FileReporter's final-block contract.
+            self._fleet.stop()
+            self._fleet = None
         if self.slo is not None:
             # Final tick first: a firing alert must reach the log (and
             # the flight ring) before the reporter writes its last
@@ -335,7 +368,15 @@ def enable(config) -> Telemetry:
             alert_log=getattr(config, "alert_log", ""),
             slo_specs=_slo_specs_from(config),
             slo_fast_s=getattr(config, "slo_fast_s", 60.0),
-            slo_slow_s=getattr(config, "slo_slow_s", 300.0))
+            slo_slow_s=getattr(config, "slo_slow_s", 300.0),
+            fleet_push=getattr(config, "fleet_push", ""),
+            fleet_role=getattr(config, "fleet_role", ""),
+            fleet_instance=(getattr(config, "fleet_instance", "")
+                            or getattr(config, "fed_worker", "")),
+            fleet_push_interval_s=getattr(config,
+                                          "fleet_push_interval_s", 2.0),
+            metric_series_max=getattr(config, "metric_series_max",
+                                      1024))
         t.start()
         TELEMETRY = t
         return t

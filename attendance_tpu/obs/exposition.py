@@ -307,6 +307,42 @@ def quantiles_from_cumulative(pairs, qs) -> List[float]:
     return out
 
 
+def fold_headline_samples(samples, acc: Optional[dict] = None) -> dict:
+    """Fold one exposition's parsed samples into the shared headline
+    accumulator — the ONE definition of the cross-role headline
+    numbers both fleet surfaces read (`fleet` status/dashboard and
+    ``doctor --fleet``'s fleet-wide rows): events sum, SLO-firing
+    count, per-sample read-staleness values, the series self-gauge,
+    and merge-lag cumulative buckets summed by ``le`` (so folding
+    several roles' samples yields the merged histogram). Pass the
+    returned ``acc`` back in to accumulate across instances."""
+    if acc is None:
+        acc = {"events": 0.0, "have_events": False, "firing": 0,
+               "staleness": [], "series": None, "lag_by_le": {}}
+    for name, labels, value in samples:
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        if math.isnan(v):
+            continue
+        if name == "attendance_events_total":
+            acc["events"] += v
+            acc["have_events"] = True
+        elif name == "attendance_slo_firing" and v >= 1.0:
+            acc["firing"] += 1
+        elif name == "attendance_read_staleness_seconds":
+            acc["staleness"].append(v)
+        elif name == "attendance_metric_series_total":
+            acc["series"] = int(v)
+        elif name == "attendance_fed_merge_lag_seconds_bucket":
+            le = _parse_le(labels)
+            if le is not None:
+                acc["lag_by_le"][le] = (acc["lag_by_le"].get(le, 0.0)
+                                        + v)
+    return acc
+
+
 def format_prom_table(text: str) -> str:
     """Live-style table of the last scrape block of a prom file.
     Histograms are folded to count/sum/mean plus p50/p95/p99 derived

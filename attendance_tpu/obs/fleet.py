@@ -1,0 +1,651 @@
+"""Fleet observability plane: cross-process telemetry collection.
+
+PRs 6-8 turned the single-process reproduction into a fleet — striped
+ingress lanes, a separate chain-reader serve process, federation shard
+workers plus an aggregator — but the PR 1-3 observability stack stayed
+strictly per-process: each role wrote its own prom file, its own
+trace.json, its own alert log. This module is the one pane of glass:
+
+* :class:`FleetPusher` — every process role (worker, aggregator, serve
+  reader, broker, bench driver) periodically pushes its registry
+  snapshot and bounded span batches as length-prefixed frames over the
+  shared :mod:`transport.framing` wire. Pushes ride
+  ``resilient_call``'s retry/reconnect/chaos seams at the new
+  ``fleet.push`` site, and a dead collector NEVER hurts the pushing
+  process — pushing is telemetry, not durability.
+* :class:`FleetCollector` — accepts pushes, maintains a role+instance-
+  labeled merged registry re-exposed on the existing metrics HTTP
+  endpoint under ``/fleet/*`` (``/fleet/metrics`` merged exposition,
+  ``/fleet/status`` JSON summary, ``/fleet/trace`` stitched trace),
+  and stitches every process's span batches into ONE Perfetto-loadable
+  export — trace/span ids are process-global 64-bit randoms and the
+  federation gossip now carries ``traceparent``, so an aggregator's
+  ``fed_merge`` span parents under the originating worker's
+  ``fence_publish`` span across process boundaries.
+* Artifact persistence — with a directory configured, the collector
+  appends each instance's exposition blocks to
+  ``<role>@<instance>.prom`` (the FileReporter block format, so every
+  existing prom consumer works), and flushes ``fleet_trace.json`` +
+  ``FLEET.json`` (the status snapshot) — the inputs ``doctor --fleet``
+  merges into one verdict table and CI uploads on failure.
+
+Wire: one opcode (``F_PUSH = 1``), body = ``enc_props(header) +
+payload``; header names ``role``, ``instance``, ``kind``
+(``metrics`` | ``spans``), ``seq``, ``boot`` (the pusher's
+construction timestamp — with ``seq`` it makes pushes idempotent:
+``resilient_call`` may re-send a frame whose reply was lost, and the
+collector drops ``seq <= last-seen`` within one ``boot`` while a
+restarted pusher's fresh ``boot`` resets the window) and ``ts``. ``metrics`` payloads are
+the process's rendered Prometheus exposition; ``spans`` payloads are a
+JSON array of compact rows ``[name, role, tid, thread, ts_us, dur_us,
+trace_id, span_id, parent_id|null, args|null]`` with ``ts``/``dur``
+already converted to unix-epoch microseconds (each process's tracer
+anchors its monotonic clock at construction, so stitched timelines
+roughly align the way the per-process exports already did). Rows, not
+span documents: shipping rides the hot loop's cores, and the dict keys
+plus hex-id formatting tripled the serialize cost — ids travel as raw
+ints and become Perfetto ``args`` strings once, at export.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import socket
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from attendance_tpu.transport.framing import (
+    dec_props, enc_props, recv_frame, send_frame)
+
+logger = logging.getLogger(__name__)
+
+F_PUSH = 1
+
+_ST_OK = 0
+_ST_ERROR = 2
+
+# Bound on spans per periodic push frame (a push is a telemetry
+# heartbeat, not a bulk transfer: one 512-row frame costs ~5ms on a
+# slow 2-core host — invisible at the 2s cadence — where a 64k-span
+# backlog serialized at once parks the GIL for over a second), the
+# larger frame the stop()-time full drain uses, and the spans retained
+# per instance at the collector.
+SPAN_BATCH = 512
+DRAIN_BATCH = 4096
+COLLECTOR_SPAN_LIMIT = 1 << 16
+
+FLEET_ROUTES = ("/fleet/metrics", "/fleet/status", "/fleet/trace")
+
+TRACE_FILE = "fleet_trace.json"
+STATUS_FILE = "FLEET.json"
+
+
+def default_instance(config=None) -> str:
+    """Stable-ish instance label: the federated worker id when one is
+    configured (the name every soak/bench log already uses), else the
+    pid."""
+    fed = getattr(config, "fed_worker", "") if config is not None else ""
+    return fed or f"pid{os.getpid()}"
+
+
+def _span_rows(spans, epoch: float) -> list:
+    """Completed Spans -> compact wire rows, ts/dur wall-anchored."""
+    return [[s.name, s.role, s.tid, s.thread_name,
+             round((epoch + s.t0) * 1e6, 3),
+             round(s.dur * 1e6, 3),
+             s.trace_id, s.span_id, s.parent_id, s.args]
+            for s in spans]
+
+
+def _row_args(row: list) -> dict:
+    """One wire row -> the Perfetto ``args`` dict (the same shape
+    Tracer.export writes: hex ids + the span's own args)."""
+    args = {"trace_id": f"{row[6]:016x}", "span_id": f"{row[7]:016x}"}
+    if row[8] is not None:
+        args["parent_span_id"] = f"{row[8]:016x}"
+    if row[9]:
+        args.update(row[9])
+    return args
+
+
+# ---------------------------------------------------------------------------
+# Push side
+# ---------------------------------------------------------------------------
+
+class FleetPusher:
+    """Background thread pushing one process's telemetry to the
+    collector: a rendered registry snapshot every interval plus the
+    spans completed since the last push (bounded per frame).
+
+    Deliberately decoupled from :class:`obs.Telemetry` construction
+    (takes the registry/tracer handles directly) so tests can run
+    several pushers with independent registries inside one process —
+    exactly how the hermetic fleet tests simulate a multi-role
+    deployment."""
+
+    def __init__(self, registry, tracer, address: str, *, role: str,
+                 instance: str, interval_s: float = 2.0,
+                 policy=None, span_batch: int = SPAN_BATCH):
+        from attendance_tpu import chaos
+        # Pay the exposition import (it drags http.server in) here at
+        # construction, not inside the first push — a pusher starts
+        # before the hot loop and must never hiccup it.
+        from attendance_tpu.obs.exposition import render
+        from attendance_tpu.transport.resilience import RetryPolicy
+        from attendance_tpu.transport.socket_broker import _Rpc
+
+        self._render = render
+        self.registry = registry
+        self.tracer = tracer
+        self.address = address
+        self.role = role
+        self.instance = instance
+        self.interval_s = interval_s
+        self.span_batch = span_batch
+        # Short budget: a push that cannot land within a couple of
+        # seconds should yield to the next interval, not park the
+        # pusher thread for the transport's full 15s default.
+        self._policy = policy or RetryPolicy(budget_s=2.0)
+        self._rpc = None
+        self._rpc_factory = lambda: _Rpc(address, chaos=chaos.get(),
+                                         site="fleet.push")
+        self._seq = 0
+        self._boot = round(time.time(), 3)
+        self._span_cursor = 0
+        self._down_logged = False
+        self._stop = threading.Event()
+        self._push_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "FleetPusher":
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-pusher", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.push_now()
+
+    def _call(self, header: dict, payload: bytes) -> None:
+        from attendance_tpu.transport.resilience import resilient_call
+
+        if self._rpc is None:
+            self._rpc = self._rpc_factory()
+        body = enc_props(header) + payload
+        status, reply = resilient_call(
+            self._rpc, lambda: (F_PUSH, body), site="fleet.push",
+            policy=self._policy, aborted=self._stop.is_set)
+        if status != _ST_OK:
+            raise RuntimeError(
+                f"collector rejected push: "
+                f"{reply.decode(errors='replace')}")
+
+    def push_now(self, *, drain: bool = False) -> bool:
+        """One push round (metrics + fresh spans); returns whether it
+        landed. Spans ship at most ONE bounded frame per round — a big
+        backlog paces out over successive intervals instead of parking
+        the GIL on one giant serialize (the hot loop shares these
+        cores); ``drain=True`` (the stop() path) loops until empty. A
+        collector outage logs ONE warning and the pusher keeps trying
+        every interval — the pushing process must never degrade
+        because its telemetry sink did."""
+        with self._push_lock:
+            try:
+                self._seq += 1
+                header = {"role": self.role, "instance": self.instance,
+                          "kind": "metrics", "seq": self._seq,
+                          "boot": self._boot,
+                          "ts": round(time.time(), 3)}
+                self._call(header, self._render(self.registry).encode())
+                if self.tracer is not None:
+                    epoch = self.tracer.epoch
+                    limit = DRAIN_BATCH if drain else self.span_batch
+                    while True:
+                        batch, end = self.tracer.snapshot_from(
+                            self._span_cursor, limit)
+                        if not batch:
+                            break
+                        self._seq += 1
+                        self._call(
+                            {**header, "kind": "spans",
+                             "seq": self._seq},
+                            json.dumps(_span_rows(batch,
+                                                  epoch)).encode())
+                        self._span_cursor += len(batch)
+                        if not drain:
+                            break  # backlog: next interval's problem
+                        if self._span_cursor >= end:
+                            break
+            except Exception as exc:
+                try:
+                    if self._rpc is not None:
+                        self._rpc.close()
+                except Exception:
+                    pass
+                self._rpc = None
+                if not self._down_logged:
+                    self._down_logged = True
+                    logger.warning(
+                        "fleet push to %s failed (%r) — collector "
+                        "down? pushing keeps retrying every %.1fs",
+                        self.address, exc, self.interval_s)
+                return False
+            if self._down_logged:
+                self._down_logged = False
+                logger.info("fleet push to %s recovered", self.address)
+            return True
+
+    def stop(self) -> None:
+        """Final push (short runs must still report), then teardown."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.push_now(drain=True)
+        if self._rpc is not None:
+            try:
+                self._rpc.close()
+            except Exception:
+                pass
+            self._rpc = None
+
+
+# ---------------------------------------------------------------------------
+# Collector side
+# ---------------------------------------------------------------------------
+
+class _Instance:
+    __slots__ = ("role", "instance", "prom", "spans", "last_seen",
+                 "pushes", "span_count", "boot", "last_seq")
+
+    def __init__(self, role: str, instance: str):
+        self.role = role
+        self.instance = instance
+        self.prom = ""  # latest rendered exposition
+        self.spans: List[dict] = []
+        self.last_seen = 0.0
+        self.pushes = 0
+        self.span_count = 0
+        # Duplicate window: pushes are idempotent per (boot, seq) —
+        # resilient_call re-sends a frame whose reply was lost.
+        self.boot = None
+        self.last_seq = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.role}@{self.instance}"
+
+
+def _safe_stem(key: str) -> str:
+    return "".join(c if (c.isalnum() or c in "@._-") else "_"
+                   for c in key)
+
+
+class FleetCollector:
+    """TCP collector for :class:`FleetPusher` frames.
+
+    One thread per pushing connection (the broker server's model: a
+    fleet is tens of processes, not thousands). State per
+    (role, instance): the latest exposition text, a bounded span list,
+    and liveness/volume counters. ``attach(metrics_server)`` mounts the
+    ``/fleet/*`` routes on an existing :class:`MetricsServer`;
+    ``directory`` persists artifacts for ``doctor --fleet`` and CI
+    triage."""
+
+    def __init__(self, *, directory: str = "", host: str = "127.0.0.1",
+                 port: int = 0, obs=None,
+                 flush_interval_s: float = 2.0,
+                 span_limit: int = COLLECTOR_SPAN_LIMIT):
+        self.directory = directory
+        if directory:
+            Path(directory).mkdir(parents=True, exist_ok=True)
+        self.span_limit = span_limit
+        self.flush_interval_s = flush_interval_s
+        self._lock = threading.Lock()
+        self._instances: Dict[str, _Instance] = {}
+        self._last_flush = 0.0
+        self._stopping = False
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(32)
+        self.host, self.port = self._sock.getsockname()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._c_pushes = None
+        if obs is not None:
+            self.bind_obs(obs)
+
+    def bind_obs(self, obs) -> None:
+        """Register the collector's self-metrics on a telemetry
+        bundle. Separate from __init__ for the host that creates the
+        collector FIRST (to learn its ephemeral address) and the
+        telemetry bundle second, pushing to itself — the `federate`
+        verb's shape."""
+        self._c_pushes = {
+            kind: obs.registry.counter(
+                "attendance_fleet_pushes_total",
+                help="Telemetry frames accepted by the fleet "
+                "collector", kind=kind)
+            for kind in ("metrics", "spans")}
+        obs.registry.gauge(
+            "attendance_fleet_instances",
+            help="Distinct role@instance pushers the collector "
+            "has heard from").set_function(
+                lambda: float(len(self._instances)))
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "FleetCollector":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fleet-collector",
+            daemon=True)
+        self._accept_thread.start()
+        logger.info("Fleet collector listening on %s%s", self.address,
+                    f" (artifacts -> {self.directory})"
+                    if self.directory else "")
+        return self
+
+    def stop(self) -> None:
+        self._stopping = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self.flush(trace=True)
+
+    # -- wire ----------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_connection,
+                             args=(conn,),
+                             name=f"fleet-conn-{addr[1]}",
+                             daemon=True).start()
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                try:
+                    op, body = recv_frame(conn)
+                except (ConnectionError, OSError):
+                    break
+                try:
+                    if op != F_PUSH:
+                        raise ValueError(f"unknown fleet opcode {op}")
+                    self._ingest(body)
+                    status, reply = _ST_OK, b""
+                except Exception as exc:
+                    status, reply = _ST_ERROR, repr(exc).encode()
+                try:
+                    send_frame(conn, status, reply)
+                except (ConnectionError, OSError):
+                    break
+        finally:
+            conn.close()
+
+    def _ingest(self, body: bytes) -> None:
+        header, off = dec_props(body, 0)
+        if not header or "role" not in header:
+            raise ValueError("malformed fleet push header")
+        payload = body[off:]
+        kind = header.get("kind")
+        key = f"{header['role']}@{header.get('instance', '?')}"
+        boot, seq = header.get("boot"), header.get("seq")
+        persist = None
+        with self._lock:
+            inst = self._instances.get(key)
+            if inst is None:
+                inst = self._instances[key] = _Instance(
+                    header["role"], str(header.get("instance", "?")))
+            if boot is not None and seq is not None:
+                if inst.boot == boot and seq <= inst.last_seq:
+                    # resilient_call re-sent a frame whose reply was
+                    # lost: already folded, drop silently (the reply
+                    # the pusher is waiting for is this OK).
+                    inst.last_seen = time.time()
+                    return
+                if inst.boot != boot:  # restarted pusher: new window
+                    inst.boot, inst.last_seq = boot, 0
+                inst.last_seq = max(inst.last_seq, seq)
+            inst.last_seen = time.time()
+            inst.pushes += 1
+            if kind == "metrics":
+                inst.prom = payload.decode(errors="replace")
+                persist = (inst.key, inst.prom)
+            elif kind == "spans":
+                rows = json.loads(payload)
+                inst.spans.extend(rows)
+                inst.span_count += len(rows)
+                if len(inst.spans) > self.span_limit:
+                    # Keep the newest: the stitched export is a live
+                    # forensic surface, not an archive.
+                    del inst.spans[:len(inst.spans) - self.span_limit]
+            else:
+                raise ValueError(f"unknown fleet push kind {kind!r}")
+        if persist is not None:
+            # File I/O OUTSIDE the collector-wide lock: one slow 9p
+            # append must not stall every other pusher and the
+            # /fleet/* scrape routes. Per-instance ordering holds —
+            # each pusher serializes its own pushes under _push_lock.
+            self._persist_prom(*persist)
+        if self._c_pushes is not None and kind in self._c_pushes:
+            self._c_pushes[kind].inc()
+        if (self.directory
+                and time.time() - self._last_flush
+                >= self.flush_interval_s):
+            self.flush()
+
+    def _persist_prom(self, key: str, prom: str) -> None:
+        """Append the freshly pushed block to the instance's prom file
+        (the FileReporter block format — ``parse_prom`` and the
+        ``telemetry`` verb read it unchanged). Called OUTSIDE the
+        collector lock."""
+        if not self.directory:
+            return
+        path = Path(self.directory) / f"{_safe_stem(key)}.prom"
+        try:
+            with open(path, "a") as f:
+                f.write(f"# scrape {time.time():.3f}\n" + prom)
+        except OSError:
+            logger.exception("fleet prom persist failed for %s", key)
+
+    # -- merged views --------------------------------------------------------
+    def merged_exposition(self) -> str:
+        """One Prometheus exposition over every instance's latest
+        snapshot, each sample labeled ``role=``/``instance=`` —
+        samples regrouped per family so the merged text stays valid
+        exposition (TYPE before samples, families contiguous)."""
+        with self._lock:
+            blocks = [(i.role, i.instance, i.prom)
+                      for i in self._instances.values()]
+        families: Dict[str, dict] = {}
+        for role, instance, text in sorted(blocks):
+            extra = (f'role="{role}",instance="{instance}"')
+            fam = None
+            for line in text.splitlines():
+                if line.startswith("# TYPE "):
+                    _, _, name, kind = line.split(" ", 3)
+                    fam = families.setdefault(
+                        name, {"kind": kind, "help": "", "samples": []})
+                    fam["kind"] = kind  # HELP may have pre-created it
+                elif line.startswith("# HELP "):
+                    _, _, name, help_text = line.split(" ", 3)
+                    families.setdefault(
+                        name, {"kind": "untyped", "help": "",
+                               "samples": []})["help"] = help_text
+                elif line and not line.startswith("#"):
+                    try:
+                        metric, value = line.rsplit(" ", 1)
+                    except ValueError:
+                        continue
+                    if "{" in metric:
+                        name_part, rest = metric.split("{", 1)
+                        metric = f"{name_part}{{{extra},{rest}"
+                    else:
+                        metric = f"{metric}{{{extra}}}"
+                    # render() always emits samples directly under
+                    # their family's TYPE line; a stray untyped sample
+                    # (hand-written input) gets its own family.
+                    target = fam if fam is not None else \
+                        families.setdefault(
+                            metric.split("{", 1)[0],
+                            {"kind": "untyped", "help": "",
+                             "samples": []})
+                    target["samples"].append(f"{metric} {value}")
+        lines: List[str] = []
+        for name in sorted(families):
+            fam = families[name]
+            if fam["help"]:
+                lines.append(f"# HELP {name} {fam['help']}")
+            lines.append(f"# TYPE {name} {fam['kind']}")
+            lines.extend(fam["samples"])
+        return "\n".join(lines) + "\n"
+
+    def export_trace(self) -> dict:
+        """Stitch every instance's span batches into one Chrome-trace
+        document: one synthetic pid per (role, instance) — the
+        federated swimlane layout — one tid per pushing thread, span
+        args untouched (trace/span/parent ids are process-global, so
+        the gossip-carried ``traceparent`` makes an aggregator's
+        ``fed_merge`` nest under the worker's ``fence_publish`` with
+        no id translation)."""
+        with self._lock:
+            per = [(i.role, i.instance, list(i.spans))
+                   for i in self._instances.values()]
+        meta: List[dict] = []
+        events: List[dict] = []
+        pid = 0
+        for role, instance, spans in sorted(per, key=lambda p: p[:2]):
+            pid += 1
+            meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                         "tid": 0,
+                         "args": {"name": f"{role}:{instance}"}})
+            tid_of: Dict[tuple, int] = {}
+            for row in spans:
+                tkey = (row[1] or role, row[2])
+                tid = tid_of.get(tkey)
+                if tid is None:
+                    tid = tid_of[tkey] = len(tid_of) + 1
+                    events.append({
+                        "name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tid, "args": {"name": row[3] or ""}})
+                events.append({"name": row[0], "ph": "X",
+                               "pid": pid, "tid": tid,
+                               "ts": row[4], "dur": row[5],
+                               "args": _row_args(row)})
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "otherData": {"stitched": True,
+                              "instances": len(per),
+                              "span_count": sum(len(s)
+                                                for _, _, s in per)}}
+
+    def status(self) -> dict:
+        """The fleet summary the ``fleet`` verb renders: per instance,
+        liveness + volume + a few headline samples extracted from the
+        latest exposition."""
+        now = time.time()
+        with self._lock:
+            per = [(i.role, i.instance, i.prom, i.last_seen, i.pushes,
+                    i.span_count) for i in self._instances.values()]
+        doc = {"collected_at": round(now, 3), "instances": {}}
+        for role, instance, prom, last_seen, pushes, span_count in per:
+            doc["instances"][f"{role}@{instance}"] = {
+                "role": role, "instance": instance,
+                "age_s": round(now - last_seen, 3),
+                "pushes": pushes, "spans": span_count,
+                **_headline(prom),
+            }
+        return doc
+
+    # -- persistence ---------------------------------------------------------
+    def flush(self, *, trace: bool = False) -> None:
+        """Write the status snapshot (atomic rename; prom files are
+        appended per push instead), plus the stitched trace when
+        ``trace=True``. The periodic flush during pushes deliberately
+        skips the trace: serializing the whole accumulated span set is
+        O(total spans) and would grow every interval — it is written
+        once at stop() (and served live by the /fleet/trace route)."""
+        self._last_flush = time.time()
+        if not self.directory:
+            return
+        root = Path(self.directory)
+        docs = [(STATUS_FILE, self.status())]
+        if trace:
+            docs.append((TRACE_FILE, self.export_trace()))
+        try:
+            for name, doc in docs:
+                tmp = root / (name + ".tmp")
+                with open(tmp, "w") as f:
+                    json.dump(doc, f)
+                tmp.replace(root / name)
+        except OSError:
+            logger.exception("fleet artifact flush failed")
+
+    # -- HTTP ----------------------------------------------------------------
+    def attach(self, server) -> None:
+        """Mount ``/fleet/*`` on a MetricsServer (the existing
+        ``--metrics-port`` endpoint: one scrape surface per process,
+        fleet-wide views beside the local ones)."""
+
+        def metrics(method, path, query, body):
+            return (200, "text/plain; version=0.0.4; charset=utf-8",
+                    self.merged_exposition().encode())
+
+        def status(method, path, query, body):
+            return (200, "application/json; charset=utf-8",
+                    json.dumps(self.status()).encode())
+
+        def trace(method, path, query, body):
+            return (200, "application/json; charset=utf-8",
+                    json.dumps(self.export_trace()).encode())
+
+        server.add_route("/fleet/metrics", metrics)
+        server.add_route("/fleet/status", status)
+        server.add_route("/fleet/trace", trace)
+
+    def detach(self, server) -> None:
+        for path in FLEET_ROUTES:
+            server.remove_route(path)
+
+
+def _headline(prom_text: str) -> dict:
+    """A few cross-role headline numbers from one exposition snapshot
+    (best-effort: absent families simply don't appear). The extraction
+    itself is exposition.fold_headline_samples — shared with
+    ``doctor --fleet``'s fleet-wide rows so the dashboard and the gate
+    can never disagree about what a headline means."""
+    from attendance_tpu.obs.exposition import (
+        fold_headline_samples, parse_prom, quantiles_from_cumulative)
+
+    out: dict = {}
+    if not prom_text:
+        return out
+    try:
+        acc = fold_headline_samples(parse_prom(prom_text))
+    except Exception:
+        return out
+    if acc["have_events"]:
+        out["events"] = int(acc["events"])
+    out["slo_firing"] = acc["firing"]
+    if acc["staleness"]:
+        out["read_staleness_s"] = round(max(acc["staleness"]), 3)
+    if acc["series"] is not None:
+        out["series"] = acc["series"]
+    pairs = sorted(acc["lag_by_le"].items())
+    if pairs and max(c for _, c in pairs) > 0:
+        (p99,) = quantiles_from_cumulative(pairs, (0.99,))
+        out["merge_lag_p99_s"] = (round(p99, 4)
+                                  if math.isfinite(p99) else p99)
+    return out

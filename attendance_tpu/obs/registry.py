@@ -27,9 +27,12 @@ re-request handles without double-counting.
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 # Histogram geometry: bucket i counts observations whose scaled value u
 # satisfies u.bit_length() == i, i.e. u < 2**i — upper bound 2**i units.
@@ -218,16 +221,46 @@ def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
-class Registry:
-    """Get-or-create registry of metrics keyed by (name, labels)."""
+# Ceiling on distinct label sets per metric name. Per-day gauges (the
+# audit/read-error families label by day) grow one series per lecture
+# day, which is unbounded on a long multi-day run — and every series
+# costs scrape time and exposition bytes FOREVER (a registry never
+# forgets). Past the cap, new label sets fold into one per-family
+# overflow metric and the overflow is announced ONCE at ERROR.
+DEFAULT_MAX_SERIES = 1024
 
-    def __init__(self):
+SERIES_GAUGE = "attendance_metric_series_total"
+
+
+class Registry:
+    """Get-or-create registry of metrics keyed by (name, labels).
+
+    ``max_series`` caps distinct label sets per metric NAME (the
+    cardinality guard; <= 0 = unlimited): the first overflowing
+    registration logs at ERROR, and overflowing call sites receive a
+    shared per-family sink metric of the right type — still safe to
+    record into, just not exported — so a hot loop never crashes on a
+    cardinality leak and the exposition never silently balloons. The
+    registry's own series count is exported as the
+    ``attendance_metric_series_total`` self-gauge, so the approach to
+    the cap is itself observable."""
+
+    def __init__(self, max_series: int = DEFAULT_MAX_SERIES):
         self._lock = threading.Lock()
         self._metrics: Dict[Tuple, object] = {}
         # name -> (kind, help), pinned by the first registration so a
         # later get with a different kind fails loudly instead of
         # corrupting the exposition.
         self._families: Dict[str, Tuple[str, str]] = {}
+        self.max_series = max_series
+        self._series_of: Dict[str, int] = {}  # name -> label-set count
+        self._overflow: Dict[str, object] = {}  # name -> sink metric
+        self._overflow_total = 0
+        self.gauge(SERIES_GAUGE,
+                   help="Distinct metric series (name+labels) held by "
+                   "this registry — the label-cardinality guard's "
+                   "self-measurement").set_function(
+                       lambda: float(len(self._metrics)))
 
     def _get(self, kind: str, cls, name: str, help: str,
              labels: Dict[str, str], **kwargs):
@@ -245,12 +278,35 @@ class Registry:
                 raise ValueError(
                     f"metric {name} already registered as {fam[0]}, "
                     f"not {kind}")
+            if (self.max_series > 0
+                    and self._series_of.get(name, 0) >= self.max_series):
+                return self._overflow_sink(kind, cls, name, help,
+                                           **kwargs)
             if fam is None:
                 self._families[name] = (kind, help)
             m = cls(name, key[1], help=help or (fam[1] if fam else ""),
                     **kwargs)
             self._metrics[key] = m
+            self._series_of[name] = self._series_of.get(name, 0) + 1
             return m
+
+    def _overflow_sink(self, kind: str, cls, name: str, help: str,
+                       **kwargs):
+        """One shared, UNEXPORTED sink metric per overflowing family
+        (lock held by caller). Returning a real metric object keeps
+        every call-site contract (inc/set/observe) intact; keeping it
+        out of ``_metrics`` is what stops the exposition growing."""
+        self._overflow_total += 1
+        sink = self._overflow.get(name)
+        if sink is None:
+            sink = self._overflow[name] = cls(
+                name, (("overflow", "true"),), help=help, **kwargs)
+            logger.error(
+                "metric %s overflowed the label-cardinality cap "
+                "(max_series=%d): further label sets fold into one "
+                "unexported sink — a label is probably carrying an "
+                "unbounded value (day, key, id)", name, self.max_series)
+        return sink
 
     def counter(self, name: str, help: str = "", **labels) -> Counter:
         return self._get("counter", Counter, name, help, labels)

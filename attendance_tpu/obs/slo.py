@@ -777,6 +777,132 @@ def _quarantine_rows(directory: str) -> List[List[str]]:
     return rows
 
 
+def _fleet_wide_rows(per_role_samples: Dict[str, list],
+                     merge_lag_ceiling: Optional[float],
+                     staleness_ceiling: Optional[float]
+                     ) -> List[List[str]]:
+    """Fleet-level rows judged over the MERGED data: merge-lag p99
+    from the summed cumulative buckets across every artifact that has
+    the histogram (normally just the aggregator's), read staleness as
+    the worst instance, events as the sum over ingest roles, and a
+    fleet-size row. Ceilings turn the first two into gates."""
+    from attendance_tpu.obs.exposition import (
+        fold_headline_samples, quantiles_from_cumulative)
+
+    rows: List[List[str]] = []
+    rows.append(["fleet: roles collected",
+                 str(len(per_role_samples)), ">= 1",
+                 "PASS" if per_role_samples else "FAIL"])
+    # One shared extraction (exposition.fold_headline_samples) with
+    # the `fleet` dashboard's headline — folding every role's samples
+    # into one accumulator IS the merge (lag buckets sum by le).
+    acc = None
+    for samples in per_role_samples.values():
+        acc = fold_headline_samples(samples, acc)
+    acc = fold_headline_samples((), acc)
+    staleness = acc["staleness"]
+    firing = acc["firing"]
+    if acc["have_events"]:
+        rows.append(["fleet: events (sum over roles)",
+                     _fmt_value(acc["events"]), "-", "info"])
+    pairs = sorted(acc["lag_by_le"].items())
+    has_lag = bool(pairs) and max(c for _, c in pairs) > 0
+    if merge_lag_ceiling is not None:
+        # Same vacuous-pass refusal as the single-run doctor: a fleet
+        # judged with a merge-lag ceiling MUST have gossiped.
+        p99 = (quantiles_from_cumulative(pairs, (0.99,))[0]
+               if has_lag else None)
+        rows.append(["fleet: merge lag p99", _fmt_value(p99),
+                     f"<= {_fmt_value(merge_lag_ceiling)}",
+                     "FAIL" if p99 is None or p99 > merge_lag_ceiling
+                     else "PASS"])
+    elif has_lag:
+        (p99,) = quantiles_from_cumulative(pairs, (0.99,))
+        rows.append(["fleet: merge lag p99", _fmt_value(p99), "-",
+                     "info"])
+    if staleness or staleness_ceiling is not None:
+        worst = max(staleness, default=None)
+        if staleness_ceiling is None:
+            rows.append(["fleet: worst read staleness",
+                         _fmt_value(worst), "-", "info"])
+        else:
+            rows.append(["fleet: worst read staleness",
+                         _fmt_value(worst),
+                         f"<= {_fmt_value(staleness_ceiling)}",
+                         "n/a" if worst is None
+                         else ("PASS" if worst <= staleness_ceiling
+                               else "FAIL")])
+    rows.append(["fleet: SLO alerts firing across roles",
+                 str(firing), "== 0",
+                 "PASS" if firing == 0 else "FAIL"])
+    return rows
+
+
+def doctor_fleet_report(fleet_dir: str, *,
+                        fpr_ceiling: float = 0.01,
+                        hll_error_ceiling: float = 0.02,
+                        fire_burn: float = DEFAULT_FIRE_BURN,
+                        snapshot_stall_ceiling: Optional[float] = None,
+                        max_reconnects: Optional[int] = None,
+                        lane_skew_ceiling: Optional[float] = None,
+                        query_p99_ceiling: Optional[float] = None,
+                        staleness_ceiling: Optional[float] = None,
+                        merge_lag_ceiling: Optional[float] = None
+                        ) -> Tuple[str, bool]:
+    """ONE verdict table over a fleet collector's artifact directory
+    (``--fleet-dir``): every ``<role>@<instance>.prom`` the collector
+    persisted is judged with the normal per-run checks (rows prefixed
+    with the role), then fleet-WIDE rows judge the merged data —
+    merge-lag p99 over the summed histograms, worst read staleness,
+    roles collected, alerts firing anywhere. Exit semantics match
+    :func:`doctor_report`: the CLI maps (text, ok=False) to exit 1,
+    unreadable input raises (exit 2)."""
+    from attendance_tpu.obs.exposition import _table, parse_prom
+
+    root = Path(fleet_dir)
+    if not root.is_dir():
+        raise FileNotFoundError(f"no fleet artifact dir: {fleet_dir}")
+    prom_files = sorted(root.glob("*.prom"))
+    if not prom_files:
+        raise ValueError(
+            f"fleet dir {fleet_dir} holds no *.prom artifacts — was "
+            "the collector given a --fleet-dir?")
+    rows: List[List[str]] = []
+    per_role_samples: Dict[str, list] = {}
+    for path in prom_files:
+        role = path.stem  # role@instance
+        text = path.read_text()
+        per_role_samples[role] = parse_prom(text)
+        for row in _prom_checks(text, fpr_ceiling, hll_error_ceiling,
+                                fire_burn, snapshot_stall_ceiling,
+                                max_reconnects, lane_skew_ceiling,
+                                query_p99_ceiling,
+                                staleness_ceiling=None,
+                                merge_lag_ceiling=None):
+            rows.append([f"{role}: {row[0]}", *row[1:]])
+    rows.extend(_fleet_wide_rows(per_role_samples, merge_lag_ceiling,
+                                 staleness_ceiling))
+    trace_path = root / "fleet_trace.json"
+    if trace_path.exists():
+        doc = json.loads(trace_path.read_text())
+        other = doc.get("otherData", {})
+        names = {e.get("name") for e in doc.get("traceEvents", [])
+                 if e.get("ph") == "X"}
+        stitched = {"fence_publish", "fed_merge"} <= names
+        rows.append(["fleet: stitched trace",
+                     f"{other.get('span_count', 0)} spans / "
+                     f"{other.get('instances', 0)} instances"
+                     + (", fence->merge stitched" if stitched else ""),
+                     "-", "info"])
+    ok = not any(r[3] == "FAIL" for r in rows)
+    failed = sum(1 for r in rows if r[3] == "FAIL")
+    head = [f"doctor --fleet: {len(prom_files)} role artifact(s) "
+            f"under {fleet_dir}",
+            _table(rows, ["check", "value", "target", "verdict"]),
+            f"verdict: {'PASS' if ok else f'FAIL ({failed} breached)'}"]
+    return "\n".join(head), ok
+
+
 def doctor_report(paths: Sequence[str], *,
                   fpr_ceiling: float = 0.01,
                   hll_error_ceiling: float = 0.02,

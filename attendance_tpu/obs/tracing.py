@@ -40,7 +40,7 @@ import random
 import threading
 import time
 from pathlib import Path
-from typing import Dict, List, NamedTuple, Optional
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 DEFAULT_SPAN_LIMIT = 1 << 16  # ~64k completed spans (~15MB exported)
 
@@ -136,6 +136,13 @@ class Tracer:
 
     def now(self) -> float:
         return self._clock()
+
+    @property
+    def epoch(self) -> float:
+        """Wall time at clock()==0 — what converts a span's monotonic
+        ``t0`` to the unix-epoch microseconds the export (and the
+        fleet pusher's cross-process stitching) uses."""
+        return self._epoch
 
     @property
     def dropped(self) -> int:
@@ -234,6 +241,19 @@ class Tracer:
     def snapshot(self) -> List[Span]:
         with self._lock:
             return list(self._spans)
+
+    def snapshot_from(self, start: int, limit: Optional[int] = None
+                      ) -> Tuple[List[Span], int]:
+        """Up to ``limit`` completed spans appended since cursor
+        ``start``, plus the cursor of the buffer END (so a caller can
+        tell backlog remains) — the fleet pusher's incremental read.
+        The buffer only ever appends (drops past the limit never
+        reorder it), so an index cursor is stable across snapshots,
+        and a bounded read copies only what it ships."""
+        with self._lock:
+            end = len(self._spans)
+            stop = end if limit is None else min(end, start + limit)
+            return list(self._spans[start:stop]), end
 
     def export(self) -> dict:
         """The Chrome trace-event document (Perfetto /

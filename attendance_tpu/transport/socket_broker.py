@@ -848,17 +848,20 @@ class SocketClient:
         self._rpc.close()
 
 
-def spawn_broker(*, cwd=None):
+def spawn_broker(*, cwd=None, fleet_push: str = ""):
     """Spawn a standalone broker subprocess on an ephemeral port and
     return ``(proc, addr)`` once its startup line names the address.
-    The caller owns teardown (``proc.kill()``)."""
+    The caller owns teardown (``proc.kill()``). ``fleet_push`` points
+    the broker's telemetry at a fleet collector (role=broker)."""
     import subprocess
     import sys
 
+    cmd = [sys.executable, "-m",
+           "attendance_tpu.transport.socket_broker", "--port", "0"]
+    if fleet_push:
+        cmd += ["--fleet-push", fleet_push]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "attendance_tpu.transport.socket_broker",
-         "--port", "0"],
-        stdout=subprocess.PIPE, text=True,
+        cmd, stdout=subprocess.PIPE, text=True,
         cwd=None if cwd is None else str(cwd))
     line = (proc.stdout.readline() or "").strip()
     if not line:
@@ -883,14 +886,20 @@ def main(argv=None) -> None:
                    "(0 = off, -1 = ephemeral)")
     p.add_argument("--metrics-prom", default="",
                    help="append Prometheus exposition blocks here")
+    p.add_argument("--fleet-push", default="",
+                   help="push this broker's telemetry (queue depths, "
+                   "traffic counters) to a fleet collector at "
+                   "HOST:PORT")
     args = p.parse_args(argv)
-    if args.metrics_port or args.metrics_prom:
+    if args.metrics_port or args.metrics_prom or args.fleet_push:
         # Enable BEFORE the broker exists so its subscriptions register
         # queue-depth gauges as clients subscribe.
         from attendance_tpu import obs
         from attendance_tpu.config import Config
         obs.enable(Config(metrics_port=args.metrics_port,
-                          metrics_prom=args.metrics_prom))
+                          metrics_prom=args.metrics_prom,
+                          fleet_push=args.fleet_push,
+                          fleet_role="broker"))
     server = BrokerServer(host=args.host, port=args.port).start()
     print(f"broker listening on {server.address}", flush=True)
     try:

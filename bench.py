@@ -648,18 +648,28 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
                        num_banks: int) -> dict:
     """Telemetry-overhead guardrail for the fused e2e path.
 
-    Four converged e2e measurements in one process: telemetry
+    Five converged e2e measurements in one process: telemetry
     DISABLED (the shipped default — every obs hook short-circuits on
     one branch), METRICS-ONLY enabled in-memory (registry + flight
     ring live; no reporter/server I/O, isolating hook cost from scrape
     cost), METRICS+TRACING (the span tracer live on top — per-batch
-    span allocation, context parse, buffer append), and
+    span allocation, context parse, buffer append),
     METRICS+TRACING+AUDIT (the shadow auditor recording at the default
-    1% sample on top of everything). The report carries the
-    per-feature deltas; ``guardrail_pass`` asserts the FULLY enabled
-    run holds the <= 2% budget — strictly harder than the
-    disabled-path requirement the telemetry design makes structural (a
-    hook that records nothing cannot cost more than one that does).
+    1% sample on top of everything), and FLEET — everything above PLUS
+    a live FleetCollector in-process with the pusher shipping registry
+    snapshots and span batches at the shipped default cadence (2s —
+    the configuration the guardrail exists to bound; hostile cadences
+    are a tuning exercise, not the shipped cost). The report carries
+    the per-feature
+    deltas; ``guardrail_pass`` asserts the FULLY enabled run holds the
+    <= 2% budget. ``fleet_guardrail_pass`` is host-scaled like the
+    ingress/federation gates (``fleet_gate`` records which form
+    applied): on >2-core hosts the collector plane must hold the same
+    <= 2% vs disabled; on a <=2-core host — where this stage co-hosts
+    the collector (a separate process in any real deployment) plus
+    the pusher against the hot loop on two cores, and between-stage
+    baseline drift alone exceeds the budget — the bound is <= 10%
+    incremental over the audited stage, its temporal neighbor.
     """
     import tempfile
 
@@ -690,6 +700,28 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
                                 num_banks)
         finally:
             obs.disable()
+    # Fleet plane on top of everything: a live collector in-process,
+    # this process pushing its whole registry + span batches to it at
+    # the shipped default cadence. The pusher is a background thread
+    # riding resilient_call; its cost to the hot loop must be the same
+    # "one branch" story as the rest of the stack.
+    from attendance_tpu.obs.fleet import FleetCollector
+
+    with tempfile.TemporaryDirectory() as tdir:
+        collector = FleetCollector(directory=tdir, port=0).start()
+        obs.enable(Config(flight_recorder=256,
+                          trace_out=os.path.join(tdir, "trace.json"),
+                          audit_sample=0.01,
+                          fleet_push=collector.address,
+                          fleet_role="bench"))
+        try:
+            fleet = bench_e2e(batch_size, seconds, capacity, num_banks)
+        finally:
+            obs.disable()
+            collector.stop()
+            fleet_pushes = sum(
+                i["pushes"]
+                for i in collector.status()["instances"].values())
     # Disabled fault plane (--chaos off): the injector is INSTALLED —
     # every transport/writer seam rolls against it — but every
     # probability is zero, so the measured delta vs the no-plane
@@ -707,6 +739,7 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
     metrics_frac = 1.0 - metrics_only["events_per_sec"] / base
     traced_frac = 1.0 - traced["events_per_sec"] / base
     audited_frac = 1.0 - audited["events_per_sec"] / base
+    fleet_frac = 1.0 - fleet["events_per_sec"] / base
     chaos_frac = 1.0 - chaos_off["events_per_sec"] / base
     return {
         "disabled_events_per_sec": round(disabled["events_per_sec"], 1),
@@ -724,6 +757,29 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
         "overhead_frac": round(audited_frac, 4),
         "audit_sample": 0.01,
         "guardrail_pass": audited_frac <= 0.02,
+        # The fleet plane's own column: everything above PLUS the
+        # collector + pusher live, and its guardrail. Host-scaled like
+        # the ingress/federation gates: on >2-core hosts the pusher
+        # rides spare cores and must hold <= 2% vs disabled; on a
+        # <=2-core host this stage co-hosts the COLLECTOR (a separate
+        # process in any real deployment) plus the pusher against the
+        # hot loop on the same two cores, a structural contention the
+        # ingress bench already documents — there the bound is <= 10%
+        # incremental over the fully-enabled (audited) stage, its
+        # temporal neighbor, which also cancels the 2-core container's
+        # large between-stage drift. fleet_gate records which form
+        # applied.
+        "fleet_events_per_sec": round(fleet["events_per_sec"], 1),
+        "fleet_overhead_frac": round(fleet_frac, 4),
+        "fleet_push_count": fleet_pushes,
+        "fleet_gate": ("<=2% vs disabled"
+                       if (os.cpu_count() or 1) > 2
+                       else "<=10% vs audited (<=2-core host: "
+                       "co-hosted collector)"),
+        "fleet_guardrail_pass": (
+            fleet_frac <= 0.02 if (os.cpu_count() or 1) > 2
+            else (1.0 - fleet["events_per_sec"]
+                  / max(audited["events_per_sec"], 1e-9)) <= 0.10),
         # The disabled fault plane's own column (--chaos off: injector
         # installed, probabilities zero) and its <= 1% guardrail.
         "chaos_off_events_per_sec": round(
@@ -734,9 +790,11 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
         "enabled_rates": metrics_only["rates"],
         "traced_rates": traced["rates"],
         "audited_rates": audited["rates"],
+        "fleet_rates": fleet["rates"],
         "chaos_off_rates": chaos_off["rates"],
         "converged": (disabled["converged"] and metrics_only["converged"]
                       and traced["converged"] and audited["converged"]
+                      and fleet["converged"]
                       and chaos_off["converged"]),
         "wire": disabled["wire"],
         "device": disabled["device"],
@@ -2276,13 +2334,18 @@ def main() -> None:
                 **{k: r[k] for k in
                    ("disabled_events_per_sec", "enabled_events_per_sec",
                     "traced_events_per_sec", "audited_events_per_sec",
+                    "fleet_events_per_sec",
                     "chaos_off_events_per_sec",
                     "metrics_overhead_frac", "tracing_overhead_frac",
                     "audit_overhead_frac", "audit_sample",
-                    "guardrail_pass", "chaos_off_overhead_frac",
+                    "guardrail_pass", "fleet_overhead_frac",
+                    "fleet_push_count", "fleet_gate",
+                    "fleet_guardrail_pass",
+                    "chaos_off_overhead_frac",
                     "chaos_guardrail_pass",
                     "disabled_rates", "enabled_rates",
-                    "traced_rates", "audited_rates", "chaos_off_rates",
+                    "traced_rates", "audited_rates", "fleet_rates",
+                    "chaos_off_rates",
                     "converged", "wire", "device")},
             }
         elif args.mode == "probe":

@@ -14,10 +14,28 @@ makes every pytest process after the first start warm.
 """
 
 import os
+import sys
 
 import pytest
 
 _CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+
+# Bad-cache preflight (utils/cache.py): the persistent cache on this
+# 9p filesystem can go BAD after concurrent/crashed writers (halved
+# device counters in the sharded seg/delta-wire tests; numpy segfaults
+# in columnar_store.to_columns). Detect the precondition — dir on 9p
+# with a stale/other-session bust key — and auto-clear it, replacing
+# the manual `rm -rf .jax_cache` folklore. Must run BEFORE jax reads
+# the dir.
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from attendance_tpu.utils.cache import preflight_cache  # noqa: E402
+
+_verdict = preflight_cache(_CACHE_DIR)
+if _verdict == "cleared":
+    print("[conftest] .jax_cache matched the documented bad-cache "
+          "precondition (9p + stale/other-pid bust key) and was "
+          "auto-cleared; first compiles will be cold this run",
+          file=sys.stderr)
 
 import jax  # noqa: E402
 

@@ -1,0 +1,144 @@
+"""Bad-`.jax_cache` preflight tests (utils/cache.py, ISSUE 9).
+
+The persistent XLA cache on this 9p filesystem has a documented
+corruption mode after concurrent or crashed writers (halved device
+counters / numpy segfaults; `rm -rf .jax_cache` folklore). The
+preflight replaces the folklore: every writer claims the dir with a
+bust-key file, and a claimant finding the dir on 9p with a STALE
+(unreleased, other-session) key clears it with a logged note. These
+tests drive every verdict; the 9p probe is monkeypatched so they are
+hermetic on any filesystem.
+"""
+
+import json
+import os
+
+import pytest
+
+from attendance_tpu.utils import cache as cache_mod
+from attendance_tpu.utils.cache import (
+    KEY_FILE, _release_claims, preflight_cache)
+
+
+@pytest.fixture(autouse=True)
+def _on_9p(monkeypatch):
+    """Pretend every path is on 9p (the corruption precondition);
+    individual tests override to False to prove the guard is scoped."""
+    monkeypatch.setattr(cache_mod, "_on_9p", lambda p: True)
+
+
+def _key(cache_dir) -> dict:
+    return json.loads((cache_dir / KEY_FILE).read_text())
+
+
+def test_fresh_dir_is_claimed(tmp_path):
+    cache = tmp_path / ".jax_cache"
+    assert preflight_cache(cache) == "fresh"
+    key = _key(cache)
+    assert key["pid"] == os.getpid() and not key["released"]
+
+
+def test_same_session_reclaim_keeps_entries(tmp_path):
+    cache = tmp_path / ".jax_cache"
+    preflight_cache(cache)
+    (cache / "entry.bin").write_bytes(b"compiled")
+    # A child of the claiming run (bench helper modes, spawned
+    # workers) shares the session env var and must NOT clear.
+    assert preflight_cache(cache) == "kept"
+    assert (cache / "entry.bin").exists()
+
+
+def test_live_same_session_parent_claim_is_not_overwritten(
+        tmp_path, monkeypatch):
+    """A child process of the claiming run (bench spawning helper
+    subprocesses) must NOT overwrite the parent's LIVE claim: doing so
+    would mark the key released at the CHILD's exit while the parent
+    still writes, hiding the concurrent-writer precondition from other
+    sessions."""
+    cache = tmp_path / ".jax_cache"
+    cache.mkdir()
+    session = os.environ.get(cache_mod._SESSION_ENV) or "sess-x"
+    monkeypatch.setenv(cache_mod._SESSION_ENV, session)
+    parent_pid = os.getppid()  # a live pid that is not ours
+    (cache / KEY_FILE).write_text(json.dumps(
+        {"pid": parent_pid, "session": session, "t0": 1.0,
+         "released": False}))
+    assert preflight_cache(cache) == "kept"
+    key = _key(cache)
+    assert key["pid"] == parent_pid  # untouched — the parent owns it
+    assert not key["released"]
+
+
+def test_released_key_keeps_entries(tmp_path):
+    """A clean prior exit released its claim: the next run (another
+    session) trusts the entries — warm caches survive sequential
+    runs."""
+    cache = tmp_path / ".jax_cache"
+    cache.mkdir()
+    (cache / "entry.bin").write_bytes(b"compiled")
+    (cache / KEY_FILE).write_text(json.dumps(
+        {"pid": 999999, "session": "other-session", "t0": 1.0,
+         "released": True}))
+    assert preflight_cache(cache) == "kept"
+    assert (cache / "entry.bin").exists()
+
+
+def test_pre_bustkey_dir_is_adopted(tmp_path):
+    """A dir with no key (CI-restored cache from before this check):
+    kept — unknown history is not the documented precondition."""
+    cache = tmp_path / ".jax_cache"
+    cache.mkdir()
+    (cache / "entry.bin").write_bytes(b"compiled")
+    assert preflight_cache(cache) == "adopted"
+    assert (cache / "entry.bin").exists()
+    assert _key(cache)["pid"] == os.getpid()
+
+
+def test_stale_unreleased_key_on_9p_clears(tmp_path, caplog):
+    """THE documented precondition: dir on 9p, unreleased key from a
+    dead other-session writer (crashed mid-write). Auto-clear with a
+    logged note."""
+    cache = tmp_path / ".jax_cache"
+    cache.mkdir()
+    (cache / "entry.bin").write_bytes(b"poisoned")
+    (cache / KEY_FILE).write_text(json.dumps(
+        {"pid": 2 ** 22 + 1, "session": "dead-session", "t0": 1.0,
+         "released": False}))
+    import logging
+
+    with caplog.at_level(logging.ERROR,
+                         logger="attendance_tpu.utils.cache"):
+        assert preflight_cache(cache) == "cleared"
+    assert not (cache / "entry.bin").exists()  # entries discarded
+    assert _key(cache)["pid"] == os.getpid()  # fresh claim written
+    assert any("bad-cache precondition" in r.message
+               for r in caplog.records)
+
+
+def test_stale_key_off_9p_is_kept(tmp_path, monkeypatch):
+    """The corruption is only documented on 9p: a healthy local
+    filesystem NEVER auto-clears, whatever the key says."""
+    monkeypatch.setattr(cache_mod, "_on_9p", lambda p: False)
+    cache = tmp_path / ".jax_cache"
+    cache.mkdir()
+    (cache / "entry.bin").write_bytes(b"compiled")
+    (cache / KEY_FILE).write_text(json.dumps(
+        {"pid": 2 ** 22 + 1, "session": "dead-session", "t0": 1.0,
+         "released": False}))
+    assert preflight_cache(cache) == "kept"
+    assert (cache / "entry.bin").exists()
+
+
+def test_release_marks_key_for_next_session(tmp_path):
+    cache = tmp_path / ".jax_cache"
+    preflight_cache(cache)
+    assert not _key(cache)["released"]
+    _release_claims()
+    assert _key(cache)["released"]
+    # The released key is exactly what lets a DIFFERENT session keep
+    # the entries later.
+    key = _key(cache)
+    key["session"] = "some-other-session"
+    key["pid"] = 999999
+    (cache / KEY_FILE).write_text(json.dumps(key))
+    assert preflight_cache(cache) == "kept"

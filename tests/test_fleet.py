@@ -1,0 +1,534 @@
+"""Fleet observability plane tests (ISSUE 9).
+
+Covers the pusher -> collector wire (role/instance-labeled merged
+exposition, bounded span batches, artifact persistence), federated
+trace stitching (the gossip-carried traceparent parenting an
+aggregator ``fed_merge`` under the worker's ``fence_publish`` — and
+loud tolerance of older frames without the field), ``doctor --fleet``
+verdict semantics, the ``fleet`` CLI verb, the ``telemetry --follow``
+tail mode, and the ``tools/bench_trend.py`` trajectory gate.
+"""
+
+import json
+import logging
+import sys
+import threading
+import time
+import types
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from attendance_tpu.obs.fleet import (
+    FleetCollector, FleetPusher, STATUS_FILE, TRACE_FILE)
+from attendance_tpu.obs.registry import Registry
+from attendance_tpu.obs.tracing import Tracer
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _obs_shim():
+    """The (registry, tracer) pair FenceGossip/Aggregator capture —
+    per-instance, so one test process can simulate several roles."""
+    return types.SimpleNamespace(registry=Registry(), tracer=Tracer())
+
+
+@pytest.fixture
+def collector(tmp_path):
+    col = FleetCollector(directory=str(tmp_path / "fleet"),
+                         port=0).start()
+    yield col
+    col.stop()
+
+
+def _slices(trace_doc):
+    return [e for e in trace_doc["traceEvents"] if e.get("ph") == "X"]
+
+
+# -- pusher -> collector wire ------------------------------------------------
+
+def test_push_merges_roles_with_labels_and_persists(collector,
+                                                    tmp_path):
+    r1, t1 = Registry(), Tracer(default_role="worker")
+    r1.counter("attendance_events_total", help="Events").inc(100)
+    t1.add_span("dispatch", 0.0, 0.01, trace_id=t1.new_id())
+    r2 = Registry()
+    r2.counter("attendance_events_total", help="Events").inc(50)
+
+    p1 = FleetPusher(r1, t1, collector.address, role="worker",
+                     instance="w0")
+    p2 = FleetPusher(r2, None, collector.address, role="broker",
+                     instance="b1")
+    assert p1.push_now() and p2.push_now()
+
+    merged = collector.merged_exposition()
+    assert ('attendance_events_total{role="worker",instance="w0"} 100'
+            in merged)
+    assert ('attendance_events_total{role="broker",instance="b1"} 50'
+            in merged)
+    # Merged text stays VALID exposition: one TYPE line per family,
+    # samples grouped under it.
+    assert merged.count("# TYPE attendance_events_total counter") == 1
+
+    status = collector.status()
+    assert set(status["instances"]) == {"worker@w0", "broker@b1"}
+    assert status["instances"]["worker@w0"]["events"] == 100
+    assert status["instances"]["worker@w0"]["spans"] == 1
+
+    # Artifacts: per-instance prom files in the FileReporter block
+    # format (every existing prom consumer reads them), plus the
+    # status + stitched-trace snapshots at stop().
+    fleet_dir = tmp_path / "fleet"
+    assert (fleet_dir / "worker@w0.prom").exists()
+    assert "attendance_events_total 100" in \
+        (fleet_dir / "worker@w0.prom").read_text()
+    collector.stop()
+    assert json.loads((fleet_dir / STATUS_FILE).read_text())["instances"]
+    trace = json.loads((fleet_dir / TRACE_FILE).read_text())
+    assert [e["name"] for e in _slices(trace)] == ["dispatch"]
+
+
+def test_push_paces_span_backlog_and_drains_at_stop(collector):
+    reg, tracer = Registry(), Tracer()
+    for _ in range(1000):
+        tracer.add_span("s", 0.0, 0.001, trace_id=1)
+    p = FleetPusher(reg, tracer, collector.address, role="worker",
+                    instance="w0", span_batch=64)
+    # A periodic round ships at most ONE bounded frame — a backlog
+    # must pace out over intervals, not park the GIL on one giant
+    # serialize.
+    assert p.push_now()
+    assert collector.status()["instances"]["worker@w0"]["spans"] == 64
+    p.stop()  # the stop() path drains everything
+    assert collector.status()["instances"]["worker@w0"]["spans"] == 1000
+
+
+def test_pusher_survives_dead_collector_and_recovers(tmp_path, caplog):
+    reg = Registry()
+    reg.counter("attendance_events_total", help="e").inc(1)
+    col = FleetCollector(port=0)
+    addr = col.address
+    col.stop()  # never started accepting; the port is dead
+    p = FleetPusher(reg, None, addr, role="worker", instance="w0")
+    with caplog.at_level(logging.WARNING,
+                         logger="attendance_tpu.obs.fleet"):
+        assert not p.push_now()
+        assert not p.push_now()
+    # ONE warning for the outage, not one per interval.
+    warns = [r for r in caplog.records if "fleet push" in r.message]
+    assert len(warns) == 1
+    live = FleetCollector(host="127.0.0.1", port=int(
+        addr.rsplit(":", 1)[1])).start()
+    try:
+        deadline = time.time() + 5
+        while not p.push_now():
+            assert time.time() < deadline, "pusher never recovered"
+        assert "worker@w0" in live.status()["instances"]
+    finally:
+        p.stop()
+        live.stop()
+
+
+def test_collector_drops_retried_duplicate_frames(collector):
+    """resilient_call may re-send a frame whose reply was lost: the
+    collector folds each (boot, seq) once, so span batches and push
+    counters never double-count — while a RESTARTED pusher (fresh
+    boot, seq back at 1) is accepted."""
+    from attendance_tpu.transport.framing import enc_props
+
+    rows = json.dumps([["s", "worker", 1, "t", 1.0, 2.0,
+                        7, 8, None, None]]).encode()
+    hdr = {"role": "worker", "instance": "w0", "kind": "spans",
+           "seq": 2, "boot": 10.0, "ts": 1.0}
+    body = enc_props(hdr) + rows
+    collector._ingest(body)
+    collector._ingest(body)  # identical retry: must be dropped
+    inst = collector._instances["worker@w0"]
+    assert inst.span_count == 1 and inst.pushes == 1
+    # A restarted pusher's fresh boot resets the window.
+    body2 = enc_props({**hdr, "seq": 1, "boot": 11.0}) + rows
+    collector._ingest(body2)
+    assert inst.span_count == 2 and inst.pushes == 2
+
+
+def test_collector_rejects_malformed_push_keeps_serving(collector):
+    import socket as socket_mod
+
+    from attendance_tpu.transport.framing import recv_frame, send_frame
+
+    host, port = collector.address.rsplit(":", 1)
+    with socket_mod.create_connection((host, int(port))) as sock:
+        send_frame(sock, 1, b"\x00garbage")
+        status, reply = recv_frame(sock)
+        assert status != 0 and reply
+    reg = Registry()
+    p = FleetPusher(reg, None, collector.address, role="w",
+                    instance="i")
+    assert p.push_now()  # the collector still accepts good pushes
+
+
+def test_fleet_routes_on_metrics_server(collector):
+    import urllib.request
+
+    from attendance_tpu.obs.exposition import MetricsServer
+
+    reg = Registry()
+    reg.counter("attendance_events_total", help="e").inc(9)
+    p = FleetPusher(reg, None, collector.address, role="serve",
+                    instance="s0")
+    assert p.push_now()
+    server = MetricsServer(reg, port=0).start()
+    try:
+        collector.attach(server)
+        base = f"http://127.0.0.1:{server.port}"
+        body = urllib.request.urlopen(base + "/fleet/metrics",
+                                      timeout=5).read().decode()
+        assert 'attendance_events_total{role="serve"' in body
+        doc = json.loads(urllib.request.urlopen(
+            base + "/fleet/status", timeout=5).read())
+        assert "serve@s0" in doc["instances"]
+        trace = json.loads(urllib.request.urlopen(
+            base + "/fleet/trace", timeout=5).read())
+        assert trace["otherData"]["stitched"] is True
+        collector.detach(server)
+        with pytest.raises(Exception):
+            urllib.request.urlopen(base + "/fleet/status", timeout=5)
+    finally:
+        server.stop()
+
+
+# -- federated trace stitching -----------------------------------------------
+
+def _worker_state(precision=14):
+    regs = np.zeros((1, 1 << precision), np.uint8)
+    regs[0, :4] = 3
+    counts = np.array([[7, 0], [1, 0]], np.uint32)
+    return regs, counts
+
+
+def test_gossip_traceparent_stitches_fed_merge_under_fence(
+        collector, tmp_path):
+    from attendance_tpu.config import Config
+    from attendance_tpu.federation.gossip import Aggregator, FenceGossip
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    broker = MemoryBroker()
+    wobs, aobs = _obs_shim(), _obs_shim()
+    cfg = Config(fed_worker="w0", fed_shard=0,
+                 snapshot_dir=str(tmp_path / "chain"))
+    gossip = FenceGossip(cfg, client=MemoryClient(broker), obs=wobs)
+    agg = Aggregator(client=MemoryClient(broker),
+                     topic=gossip.topic, num_shards=1,
+                     dead_after_s=30.0, obs=aobs)
+    try:
+        regs, counts = _worker_state()
+        assert gossip.publish_full(None, regs, counts, {0: 0}, 7)
+        deadline = time.time() + 10
+        while agg.poll(timeout_ms=100) == 0:
+            assert time.time() < deadline, "frame never folded"
+    finally:
+        gossip.close()
+        agg.stop()
+
+    # Ship both roles' spans to the collector and stitch.
+    FleetPusher(wobs.registry, wobs.tracer, collector.address,
+                role="worker", instance="w0").push_now(drain=True)
+    FleetPusher(aobs.registry, aobs.tracer, collector.address,
+                role="aggregator", instance="agg").push_now(drain=True)
+    slices = _slices(collector.export_trace())
+    fences = {e["args"]["span_id"]: e for e in slices
+              if e["name"] == "fence_publish"}
+    merges = [e for e in slices if e["name"] == "fed_merge"]
+    assert fences and merges
+    for m in merges:
+        assert m["args"]["parent_span_id"] in fences
+        parent = fences[m["args"]["parent_span_id"]]
+        assert m["args"]["trace_id"] == parent["args"]["trace_id"]
+
+
+def test_aggregator_tolerates_frames_without_traceparent(caplog):
+    """An OLDER worker's frames lack the header key entirely: the fold
+    must proceed normally, the merge span must degrade to a fresh
+    root, and the aggregator says so ONCE per worker."""
+    import struct
+
+    from attendance_tpu.federation.frames import (
+        FRAME_VERSION, encode_frame)
+    from attendance_tpu.federation.gossip import Aggregator
+    from attendance_tpu.transport.framing import dec_props, enc_props
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    regs, counts = _worker_state()
+    data = encode_frame(
+        worker="old", kind="full", incarnation=1.0, seq=1, shard=0,
+        fence_ts=time.time(), events=7, bank_of={0: 0},
+        arrays={"regs": regs, "counts": counts})
+    header, off = dec_props(data, 2)
+    assert header.pop("traceparent") == ""  # current build carries it
+    old_frame = (struct.pack("<H", FRAME_VERSION) + enc_props(header)
+                 + data[off:])
+
+    broker = MemoryBroker()
+    aobs = _obs_shim()
+    agg = Aggregator(client=MemoryClient(broker), topic="g",
+                     num_shards=1, dead_after_s=30.0, obs=aobs)
+    producer = MemoryClient(broker).create_producer("g")
+    try:
+        with caplog.at_level(
+                logging.WARNING,
+                logger="attendance_tpu.federation.gossip"):
+            producer.send(old_frame)
+            header["seq"] = 2
+            producer.send(struct.pack("<H", FRAME_VERSION)
+                          + enc_props(header) + data[off:])
+            deadline = time.time() + 10
+            folded = 0
+            while folded < 2:
+                folded += agg.poll(timeout_ms=100)
+                assert time.time() < deadline
+        assert agg.view.events == 7  # both frames folded normally
+        warns = [r for r in caplog.records
+                 if "no traceparent" in r.message]
+        assert len(warns) == 1  # once per worker, not per frame
+        merges = [s for s in aobs.tracer.snapshot()
+                  if s.name == "fed_merge"]
+        assert merges and all(m.parent_id is None for m in merges)
+    finally:
+        agg.stop()
+
+
+# -- doctor --fleet ----------------------------------------------------------
+
+def _write_fleet_dir(root: Path, lag_pairs=None, staleness=None,
+                     firing=0):
+    root.mkdir(parents=True, exist_ok=True)
+    worker = ["attendance_events_total 1000",
+              f"attendance_slo_firing{{slo=\"x\"}} {firing}"]
+    if staleness is not None:
+        worker.append(
+            f"attendance_read_staleness_seconds {staleness}")
+    (root / "worker@w0.prom").write_text("\n".join(worker) + "\n")
+    agg = ["attendance_events_total 1000"]
+    if lag_pairs:
+        agg.append("# TYPE attendance_fed_merge_lag_seconds histogram")
+        agg += ['attendance_fed_merge_lag_seconds_bucket{le="%s"} %d'
+                % (le, c) for le, c in lag_pairs]
+    (root / "aggregator@agg.prom").write_text("\n".join(agg) + "\n")
+
+
+def test_doctor_fleet_one_table_with_fleet_rows(tmp_path):
+    from attendance_tpu.obs.slo import doctor_fleet_report
+
+    _write_fleet_dir(tmp_path / "fleet",
+                     lag_pairs=[(0.008, 9), (1.024, 10), ("+Inf", 10)],
+                     staleness=0.5)
+    text, ok = doctor_fleet_report(str(tmp_path / "fleet"),
+                                   merge_lag_ceiling=2.0,
+                                   staleness_ceiling=1.0)
+    assert ok
+    assert "worker@w0:" in text and "aggregator@agg:" in text
+    assert "fleet: merge lag p99" in text
+    assert "fleet: worst read staleness" in text
+    assert "fleet: events (sum over roles)" in text and "2000" in text
+
+    # Breaches gate: lag p99 above the ceiling / staleness above.
+    text, ok = doctor_fleet_report(str(tmp_path / "fleet"),
+                                   merge_lag_ceiling=0.001)
+    assert not ok and "FAIL" in text
+    text, ok = doctor_fleet_report(str(tmp_path / "fleet"),
+                                   staleness_ceiling=0.1)
+    assert not ok
+
+    # A merge-lag ceiling with NO lag histogram anywhere must fail
+    # loudly, not pass vacuously.
+    _write_fleet_dir(tmp_path / "bare")
+    text, ok = doctor_fleet_report(str(tmp_path / "bare"),
+                                   merge_lag_ceiling=2.0)
+    assert not ok and "fleet: merge lag p99" in text
+
+    # Alerts firing in ANY role fail the fleet.
+    _write_fleet_dir(tmp_path / "firing", firing=1)
+    text, ok = doctor_fleet_report(str(tmp_path / "firing"))
+    assert not ok and "firing across roles" in text
+
+
+def test_doctor_fleet_cli_exit_codes(tmp_path):
+    from attendance_tpu.cli import main
+
+    _write_fleet_dir(tmp_path / "fleet",
+                     lag_pairs=[(0.008, 10), ("+Inf", 10)])
+    with pytest.raises(SystemExit) as e:
+        main(["doctor", "--fleet", str(tmp_path / "fleet"),
+              "--merge-lag-ceiling", "2.0"])
+    assert e.value.code == 0
+    with pytest.raises(SystemExit) as e:
+        main(["doctor", "--fleet", str(tmp_path / "fleet"),
+              "--merge-lag-ceiling", "0.001"])
+    assert e.value.code == 1
+    with pytest.raises(SystemExit) as e:
+        main(["doctor", "--fleet", str(tmp_path / "nope")])
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        (tmp_path / "empty").mkdir()
+        main(["doctor", "--fleet", str(tmp_path / "empty")])
+    assert e.value.code == 2
+
+
+# -- fleet CLI verb + telemetry --follow -------------------------------------
+
+def test_fleet_verb_snapshot_json_from_dir(tmp_path, capsys):
+    from attendance_tpu.cli import main
+
+    col = FleetCollector(directory=str(tmp_path / "fleet"), port=0
+                         ).start()
+    reg = Registry()
+    reg.counter("attendance_events_total", help="e").inc(3)
+    FleetPusher(reg, None, col.address, role="worker",
+                instance="w0").push_now()
+    col.stop()
+    out = tmp_path / "snap.json"
+    main(["fleet", "--dir", str(tmp_path / "fleet"),
+          "--snapshot-json", str(out)])
+    doc = json.loads(out.read_text())
+    assert doc["instances"]["worker@w0"]["events"] == 3
+    capsys.readouterr()
+    main(["fleet", "--dir", str(tmp_path / "fleet")])
+    table = capsys.readouterr().out
+    assert "worker@w0" in table and "role@instance" in table
+
+
+def test_telemetry_follow_rerenders_on_append(tmp_path, capsys):
+    from attendance_tpu.cli import _follow_file
+    from attendance_tpu.obs.exposition import render
+
+    path = tmp_path / "live.prom"
+    reg = Registry()
+    c = reg.counter("attendance_events_total", help="e")
+    c.inc(5)
+    path.write_text("# scrape 1.0\n" + render(reg))
+
+    appended = threading.Event()
+
+    def append_later():
+        time.sleep(0.3)
+        c.inc(10)
+        with open(path, "a") as f:
+            f.write("# scrape 2.0\n" + render(reg))
+        appended.set()
+
+    t = threading.Thread(target=append_later)
+    t.start()
+    renders = _follow_file(str(path), last=32, interval_s=0.05,
+                           max_rounds=40)
+    t.join()
+    assert appended.is_set()
+    assert renders >= 2  # initial render + at least the appended block
+    out = capsys.readouterr().out
+    assert out.count("== ") == renders
+    assert "15" in out  # the follow shows the LATEST block
+
+
+def test_telemetry_verb_follow_flag(tmp_path, capsys):
+    """--follow on a missing file renders nothing and exits cleanly
+    when bounded (the CLI loop is the same helper, unbounded)."""
+    from attendance_tpu.cli import _follow_file
+
+    renders = _follow_file(str(tmp_path / "never.prom"), last=8,
+                           interval_s=0.01, max_rounds=3)
+    assert renders == 0
+
+
+# -- bench trend gate --------------------------------------------------------
+
+HOST_A = {"cpu_count": 4, "device_kind": "cpu",
+          "device_platform": "cpu", "num_devices": 1}
+HOST_B = {"cpu_count": 96, "device_kind": "TPU v4",
+          "device_platform": "tpu", "num_devices": 4}
+
+
+def _write_bench(root: Path, name: str, value: float, host=None,
+                 metric="e2e_pipeline_throughput", **extra):
+    doc = {"metric": metric, "value": value, "unit": "events/sec",
+           **extra}
+    if host is not None:
+        doc["host"] = host
+    (root / name).write_text(json.dumps(doc))
+
+
+def _trend():
+    sys.path.insert(0, str(REPO / "tools"))
+    import bench_trend
+    return bench_trend
+
+
+def test_trend_gate_passes_on_committed_artifacts():
+    bt = _trend()
+    rc = bt.main(["--dir", str(REPO)])
+    assert rc == 0
+
+
+def test_trend_gate_fails_on_like_host_regression(tmp_path):
+    bt = _trend()
+    _write_bench(tmp_path, "BENCH_r01.json", 100e6, host=HOST_A,
+                 socket_events_per_sec=50e6)
+    _write_bench(tmp_path, "BENCH_r02.json", 101e6, host=HOST_A,
+                 socket_events_per_sec=44e6)  # -12% on a like host
+    assert bt.main(["--dir", str(tmp_path)]) == 1
+    # A generous ceiling lets the same trajectory pass.
+    assert bt.main(["--dir", str(tmp_path),
+                    "--max-regression", "0.2"]) == 0
+
+
+def test_trend_gate_exact_threshold_regression_fails(tmp_path):
+    bt = _trend()
+    _write_bench(tmp_path, "BENCH_r01.json", 100e6, host=HOST_A)
+    _write_bench(tmp_path, "BENCH_r02.json", 90e6, host=HOST_A)
+    assert bt.main(["--dir", str(tmp_path)]) == 1  # >=10% gates
+
+
+def test_trend_gate_skips_cross_host_and_unfingerprinted(tmp_path,
+                                                         capsys):
+    bt = _trend()
+    _write_bench(tmp_path, "BENCH_r01.json", 100e6, host=HOST_A)
+    _write_bench(tmp_path, "BENCH_r02.json", 40e9, host=HOST_B)
+    _write_bench(tmp_path, "BENCH_r03.json", 10e6)  # no fingerprint
+    _write_bench(tmp_path, "BENCH_FED_r08.json", 1e6, host=HOST_A,
+                 metric="federation_aggregate_events_per_sec")
+    assert bt.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "skipped (host changed)" in out
+    assert "skipped (unfingerprinted)" in out
+    assert "single artifact" in out
+
+
+def test_trend_gate_regression_spanning_skipped_artifact_gates(
+        tmp_path):
+    """An unfingerprinted artifact in the middle of a series must not
+    shield a like-for-like regression spanning it: the gate walks back
+    to the newest comparable predecessor."""
+    bt = _trend()
+    _write_bench(tmp_path, "BENCH_r01.json", 100e6, host=HOST_A)
+    _write_bench(tmp_path, "BENCH_r02.json", 95e6)  # no fingerprint
+    _write_bench(tmp_path, "BENCH_r03.json", 70e6, host=HOST_A)
+    assert bt.main(["--dir", str(tmp_path)]) == 1  # r01 vs r03: -30%
+    # The same middle artifact with NO comparable predecessor anywhere
+    # stays a visible skip, not a gate.
+    (tmp_path / "BENCH_r01.json").unlink()
+    assert bt.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_trend_gate_series_are_independent(tmp_path):
+    """A FED-series regression must not be compared against the E2E
+    series, and vice versa."""
+    bt = _trend()
+    _write_bench(tmp_path, "BENCH_r01.json", 100e6, host=HOST_A)
+    _write_bench(tmp_path, "BENCH_FED_r01.json", 1e6, host=HOST_A,
+                 metric="federation_aggregate_events_per_sec")
+    _write_bench(tmp_path, "BENCH_FED_r02.json", 0.5e6, host=HOST_A,
+                 metric="federation_aggregate_events_per_sec")
+    assert bt.main(["--dir", str(tmp_path)]) == 1
+    (tmp_path / "BENCH_FED_r02.json").unlink()
+    assert bt.main(["--dir", str(tmp_path)]) == 0

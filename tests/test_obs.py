@@ -9,6 +9,7 @@ contract (no telemetry object anywhere when the flags are unset).
 """
 
 import json
+import logging
 import os
 import re
 import signal
@@ -379,3 +380,150 @@ def test_cli_telemetry_verb_formats_both_artifacts(tmp_path, capsys):
     main(["telemetry", str(prom)])
     out = capsys.readouterr().out
     assert "attendance_events_total" in out and "42" in out
+
+
+# -- label-cardinality guard (ISSUE 9) ---------------------------------------
+
+def test_cardinality_cap_folds_overflow_into_unexported_sink(caplog):
+    reg = Registry(max_series=3)
+    handles = [reg.counter("leaky_total", day=str(d)) for d in range(3)]
+    with caplog.at_level(logging.ERROR,
+                         logger="attendance_tpu.obs.registry"):
+        over_a = reg.counter("leaky_total", day="3")
+        over_b = reg.counter("leaky_total", day="4")
+    # Overflowing call sites share ONE sink of the right type — still
+    # safe to record into, never exported.
+    assert over_a is over_b
+    assert over_a not in handles
+    over_a.inc(5)  # the call-site contract survives overflow
+    text = render(reg)
+    assert text.count("leaky_total{") == 3  # capped, not ballooning
+    assert "overflow" not in text
+    errors = [r for r in caplog.records
+              if "label-cardinality cap" in r.message]
+    assert len(errors) == 1  # announced ONCE, not per registration
+
+
+def test_cardinality_cap_is_per_name_and_reexport_safe():
+    reg = Registry(max_series=2)
+    reg.counter("a_total", k="1")
+    reg.counter("a_total", k="2")
+    sink = reg.counter("a_total", k="3")
+    assert reg.counter("a_total", k="3") is sink  # stable sink handle
+    # A DIFFERENT family is unaffected by a_total's overflow.
+    assert render(reg).count("b_total") == 0
+    reg.counter("b_total", k="1").inc()
+    assert 'b_total{k="1"} 1' in render(reg)
+    # Re-requesting an EXISTING label set still returns the real
+    # metric, not the sink.
+    assert reg.counter("a_total", k="1") is not sink
+
+
+def test_series_self_gauge_tracks_registry_size():
+    reg = Registry()
+    base = [v for n, _, v in parse_prom(render(reg))
+            if n == "attendance_metric_series_total"]
+    assert base == ["1"]  # the self-gauge is its own only series
+    reg.counter("x_total")
+    reg.gauge("y", day="1")
+    reg.gauge("y", day="2")
+    now = [v for n, _, v in parse_prom(render(reg))
+           if n == "attendance_metric_series_total"]
+    assert now == ["4"]
+
+
+def test_unlimited_registry_never_folds():
+    reg = Registry(max_series=0)
+    for d in range(2000):
+        reg.counter("big_total", day=str(d))
+    assert render(reg).count("big_total{") == 2000
+
+
+# -- quantiles_from_cumulative edge cases (ISSUE 9) --------------------------
+
+def test_quantiles_empty_histogram_is_nan():
+    import math
+
+    from attendance_tpu.obs.exposition import quantiles_from_cumulative
+
+    assert all(math.isnan(v) for v in
+               quantiles_from_cumulative([], (0.5, 0.99)))
+    # All-zero cumulative counts (registered, never observed): same.
+    assert all(math.isnan(v) for v in quantiles_from_cumulative(
+        [(0.001, 0.0), (float("inf"), 0.0)], (0.5, 0.99)))
+
+
+def test_quantiles_single_bucket_interpolates_from_zero():
+    from attendance_tpu.obs.exposition import quantiles_from_cumulative
+
+    (p50,) = quantiles_from_cumulative([(0.5, 4)], (0.5,))
+    assert 0.0 < p50 <= 0.5
+    (p100,) = quantiles_from_cumulative([(0.5, 4)], (1.0,))
+    assert p100 == 0.5
+
+
+def test_quantiles_inf_only_histogram_is_inf():
+    import math
+
+    from attendance_tpu.obs.exposition import quantiles_from_cumulative
+
+    out = quantiles_from_cumulative([(float("inf"), 7)], (0.5, 0.99))
+    assert all(math.isinf(v) for v in out)
+    # Mass split across a finite bucket and +Inf: median is finite,
+    # p99 lands in +Inf.
+    p50, p99 = quantiles_from_cumulative(
+        [(0.1, 5), (float("inf"), 10)], (0.5, 0.99))
+    assert p50 <= 0.1 and math.isinf(p99)
+
+
+# -- MetricsServer route mutation under concurrent scrape (ISSUE 9) ----------
+
+def test_add_remove_route_under_concurrent_scrape():
+    """The PR 7 teardown seam: the serve plane mounts and unmounts
+    /query/* on the live process-global endpoint while scrapers are
+    mid-flight. Every response must be a clean 200 (route present),
+    404 (route absent), or — never — a hung/broken connection."""
+    import threading
+    import urllib.error
+
+    reg = Registry()
+    reg.counter("attendance_events_total", help="e").inc(1)
+    from attendance_tpu.obs.exposition import MetricsServer
+
+    server = MetricsServer(reg, port=0).start()
+    base = f"http://127.0.0.1:{server.port}"
+    stop = threading.Event()
+    failures = []
+
+    def scraper(path):
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(base + path,
+                                            timeout=5) as resp:
+                    assert resp.status == 200
+            except urllib.error.HTTPError as e:
+                if e.code != 404:
+                    failures.append(e)
+            except Exception as e:  # noqa: BLE001 - any break is a fail
+                failures.append(e)
+
+    threads = [threading.Thread(target=scraper, args=(p,))
+               for p in ("/metrics", "/extra") for _ in range(2)]
+    try:
+        for t in threads:
+            t.start()
+
+        def handler(method, path, query, body):
+            return (200, "text/plain", b"ok")
+
+        deadline = time.time() + 1.5
+        while time.time() < deadline:
+            server.add_route("/extra", handler)
+            server.remove_route("/extra")
+        server.remove_route("/extra")  # idempotent on absent
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        server.stop()
+    assert not failures, failures[:3]

@@ -1,0 +1,237 @@
+"""Bench trend-regression gate (CI step): the committed ``BENCH_*.json``
+trajectory must never silently regress.
+
+Every era of this repo commits its acceptance artifact —
+``BENCH_r05.json``, ``BENCH_FED_r08.json``, ... — and the ROADMAP
+reasons from that trajectory, but nothing MACHINE-checks it: a PR that
+costs 30% of socket throughput while adding a feature lands green.
+This tool parses the whole committed trajectory and gates on headline
+regressions, with one hard honesty rule:
+
+**Only like-for-like hosts compare.** Bench numbers from a 2-core CI
+runner and a dedicated TPU host differ by orders of magnitude for
+reasons that are not regressions. Artifacts are grouped into series by
+filename (``BENCH_FED_r08.json`` -> series ``FED``, round 8), ordered
+by round, and two adjacent artifacts gate ONLY when they name the same
+``metric`` and carry equal host fingerprints (the ``host`` dict
+``bench.py`` stamps; the stable subset — cpu_count, device kind/
+platform, device count — is compared, not the kernel build string).
+Artifacts without a fingerprint (the pre-r08 era) or cross-host
+transitions are reported as ``skipped (unfingerprinted)`` /
+``skipped (host changed)`` rows — visible, never gating, never
+silently dropped. When the ADJACENT transition doesn't compare, the
+gate walks back to the newest comparable predecessor in the series:
+an unfingerprinted artifact in the middle must not shield a
+like-for-like regression spanning it.
+
+Headline columns: ``value`` plus every top-level numeric key ending in
+``_events_per_sec`` / ``_qps`` that both artifacts carry. A column
+regresses when it drops by at least ``--max-regression`` (fraction,
+default 0.10 — an exactly-10% drop FAILS) versus the newest comparable
+predecessor. Higher-is-better is assumed for all gated columns; lower-
+is-better diagnostics (lag, stall) are never gated here — doctor owns
+those ceilings.
+
+Exit codes: 0 = no gated regression (including "nothing comparable"),
+1 = at least one headline column regressed between like hosts,
+2 = unreadable input. Run:
+
+    python tools/bench_trend.py                   # repo root artifacts
+    python tools/bench_trend.py --dir /tmp/copy --max-regression 0.05
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+
+ARTIFACT_RE = re.compile(r"^BENCH(?:_(?P<series>[A-Z0-9]+))?_r"
+                         r"(?P<round>\d+)\.json$")
+
+# The host-fingerprint subset that decides like-for-like. platform()
+# and the python patch level churn without changing what the hardware
+# can do; these four are what the rates actually depend on.
+HOST_KEYS = ("cpu_count", "device_kind", "device_platform",
+             "num_devices")
+
+HEADLINE_SUFFIXES = ("_events_per_sec", "_qps")
+
+
+class Artifact:
+    __slots__ = ("path", "series", "round", "metric", "host", "columns")
+
+    def __init__(self, path: Path, series: str, rnd: int, metric: str,
+                 host: Optional[dict], columns: Dict[str, float]):
+        self.path = path
+        self.series = series
+        self.round = rnd
+        self.metric = metric
+        self.host = host
+        self.columns = columns
+
+
+def _headline_columns(doc: dict) -> Dict[str, float]:
+    """``value`` + every top-level scalar rate column. Nested dicts
+    (per-round sections, link-bytes maps) are diagnostics, not
+    headlines."""
+    cols: Dict[str, float] = {}
+    v = doc.get("value")
+    if isinstance(v, (int, float)) and math.isfinite(v):
+        cols["value"] = float(v)
+    for key, val in doc.items():
+        if (isinstance(val, (int, float)) and not isinstance(val, bool)
+                and math.isfinite(val)
+                and any(key.endswith(s) for s in HEADLINE_SUFFIXES)):
+            cols[key] = float(val)
+    return cols
+
+
+def load_artifact(path: Path) -> Optional[Artifact]:
+    """One parsed artifact, or None (with a note) when the filename or
+    body doesn't fit the trajectory shape. Both committed shapes load:
+    the driver wrapper ``{"cmd": ..., "parsed": {...}}`` and the bare
+    bench document."""
+    m = ARTIFACT_RE.match(path.name)
+    if m is None:
+        return None
+    doc = json.loads(path.read_text())
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict) or "metric" not in doc:
+        print(f"[trend] {path.name}: no 'metric' key — skipped")
+        return None
+    host = doc.get("host")
+    return Artifact(path, m.group("series") or "E2E",
+                    int(m.group("round")), str(doc["metric"]),
+                    host if isinstance(host, dict) else None,
+                    _headline_columns(doc))
+
+
+def host_key(host: Optional[dict]) -> Optional[Tuple]:
+    if not host:
+        return None
+    return tuple(host.get(k) for k in HOST_KEYS)
+
+
+def compare(prev: Artifact, cur: Artifact, max_regression: float
+            ) -> List[List[str]]:
+    """Rows for one adjacent transition inside a series. Gating rows
+    carry PASS/FAIL; non-comparable transitions carry one skip row."""
+    base = f"{prev.path.name} -> {cur.path.name}"
+    if prev.metric != cur.metric:
+        return [[base, "-", "-", "-",
+                 f"skipped (metric changed: {prev.metric} -> "
+                 f"{cur.metric})"]]
+    if prev.host is None or cur.host is None:
+        return [[base, "-", "-", "-", "skipped (unfingerprinted)"]]
+    if host_key(prev.host) != host_key(cur.host):
+        return [[base, "-", "-", "-", "skipped (host changed)"]]
+    rows: List[List[str]] = []
+    shared = sorted(set(prev.columns) & set(cur.columns))
+    if not shared:
+        return [[base, "-", "-", "-", "skipped (no shared columns)"]]
+    for col in shared:
+        old, new = prev.columns[col], cur.columns[col]
+        if old <= 0:
+            continue
+        drop = 1.0 - new / old
+        # >= with an epsilon: an exactly-threshold drop gates (and
+        # 1 - 90/100 is 0.0999... in floats).
+        verdict = ("FAIL" if drop >= max_regression - 1e-9
+                   else "PASS")
+        rows.append([f"{base} {col}",
+                     f"{old:,.1f} -> {new:,.1f}",
+                     f"{-drop:+.1%}",
+                     f"> -{max_regression:.0%}", verdict])
+    return rows
+
+
+def run_gate(paths: List[Path], max_regression: float) -> Tuple[str, bool]:
+    from attendance_tpu.obs.exposition import _table
+
+    artifacts = [a for a in (load_artifact(p) for p in sorted(paths))
+                 if a is not None]
+    if not artifacts:
+        return "[trend] no BENCH_*.json artifacts found", True
+    series: Dict[str, List[Artifact]] = {}
+    for a in artifacts:
+        series.setdefault(a.series, []).append(a)
+    rows: List[List[str]] = []
+    for name in sorted(series):
+        arts = sorted(series[name], key=lambda a: a.round)
+        if len(arts) == 1:
+            rows.append([f"{arts[0].path.name}", "-", "-", "-",
+                         "info (single artifact)"])
+        for i, cur in enumerate(arts[1:], 1):
+            # Gate against the NEWEST COMPARABLE predecessor, not just
+            # the adjacent artifact: an unfingerprinted or cross-host
+            # artifact in the middle of a series must not shield a
+            # like-for-like regression spanning it. The adjacent
+            # transition still gets its visible skip row when it is
+            # the one that didn't compare.
+            prev = arts[i - 1]
+            if (prev.metric != cur.metric or prev.host is None
+                    or cur.host is None
+                    or host_key(prev.host) != host_key(cur.host)):
+                rows.extend(compare(prev, cur, max_regression))
+                for cand in reversed(arts[:i - 1]):
+                    if (cand.metric == cur.metric
+                            and cand.host is not None
+                            and cur.host is not None
+                            and host_key(cand.host)
+                            == host_key(cur.host)):
+                        rows.extend(compare(cand, cur, max_regression))
+                        break
+            else:
+                rows.extend(compare(prev, cur, max_regression))
+    failed = sum(1 for r in rows if r[4] == "FAIL")
+    gated = sum(1 for r in rows if r[4] in ("PASS", "FAIL"))
+    head = (f"bench trend: {len(artifacts)} artifact(s), "
+            f"{gated} gated column transition(s), "
+            f"max regression {max_regression:.0%}")
+    table = _table(rows, ["transition", "values", "delta", "target",
+                          "verdict"])
+    tail = ("verdict: PASS" if failed == 0
+            else f"verdict: FAIL ({failed} column(s) regressed)")
+    return "\n".join([head, table, tail]), failed == 0
+
+
+def main(argv=None) -> int:
+    sys.path.insert(0, str(REPO))
+    ap = argparse.ArgumentParser(
+        description="gate the committed BENCH_*.json trajectory on "
+        "headline-column regressions between like-for-like hosts")
+    ap.add_argument("--dir", default=str(REPO),
+                    help="directory holding the BENCH_*.json "
+                    "trajectory (default: repo root)")
+    ap.add_argument("--max-regression", type=float, default=0.10,
+                    help="gated fraction: a headline column dropping "
+                    "by at least this much vs its newest comparable "
+                    "predecessor FAILS (default 0.10)")
+    ap.add_argument("artifacts", nargs="*",
+                    help="explicit artifact files (overrides --dir "
+                    "globbing)")
+    args = ap.parse_args(argv)
+    if not (0.0 < args.max_regression < 1.0):
+        print("[trend] --max-regression must be in (0, 1)")
+        return 2
+    paths = ([Path(p) for p in args.artifacts] if args.artifacts
+             else sorted(Path(args.dir).glob("BENCH*.json")))
+    try:
+        text, ok = run_gate(paths, args.max_regression)
+    except (OSError, ValueError) as e:
+        print(f"[trend] unreadable artifacts: {e}")
+        return 2
+    print(text)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

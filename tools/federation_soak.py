@@ -68,7 +68,8 @@ def _worker_log(workdir: Path, shard: int) -> Path:
 
 def _spawn_worker(addr: str, workdir: Path, shard: int, n_events: int,
                   seed: int, *, takeover: bool = False,
-                  ready: str = "", go: str = "") -> subprocess.Popen:
+                  ready: str = "", go: str = "",
+                  fleet_push: str = "") -> subprocess.Popen:
     cmd = [sys.executable, "-m", "attendance_tpu.federation.worker",
            "--worker", f"w{shard}", "--shard", str(shard),
            "--num-shards", str(K), "--broker", addr,
@@ -76,6 +77,8 @@ def _spawn_worker(addr: str, workdir: Path, shard: int, n_events: int,
            "--workdir", str(workdir), "--data-plane", "socket",
            "--num-events", str(n_events), "--seed", str(seed),
            "--snapshot-every", "2", "--idle-timeout-s", "4"]
+    if fleet_push:
+        cmd += ["--fleet-push", fleet_push]
     if takeover:
         cmd.append("--takeover")
     if ready:
@@ -124,10 +127,28 @@ def main() -> int:
         SocketClient, spawn_broker)
 
     n_events = args.frames_per_shard * DEFAULT_BATCH
-    telemetry = obs.enable(Config(metrics_prom=str(prom),
-                                  metrics_interval_s=0.2))
 
-    broker_proc, addr = spawn_broker(cwd=REPO)
+    # Fleet collector (ISSUE 9): the driver hosts it, every role —
+    # broker subprocess, 3+1 workers, the in-process aggregator —
+    # pushes registry snapshots + span batches to it, and gate E runs
+    # `doctor --fleet` over the persisted artifact dir (ONE verdict
+    # table, per-role rows + fleet-wide merge-lag gate).
+    from attendance_tpu.obs.fleet import FleetCollector
+
+    fleet_dir = work / "fleet"
+    collector = FleetCollector(directory=str(fleet_dir), port=0).start()
+    print(f"[soak] fleet collector on {collector.address} "
+          f"(artifacts -> {fleet_dir})", flush=True)
+
+    telemetry = obs.enable(Config(metrics_prom=str(prom),
+                                  metrics_interval_s=0.2,
+                                  fleet_push=collector.address,
+                                  fleet_role="aggregator",
+                                  fleet_instance="agg",
+                                  fleet_push_interval_s=0.5))
+
+    broker_proc, addr = spawn_broker(cwd=REPO,
+                                     fleet_push=collector.address)
     agg_client = SocketClient(addr)
     agg = Aggregator(client=agg_client, topic=GOSSIP_TOPIC,
                      num_shards=K, dead_after_s=args.dead_after_s,
@@ -139,7 +160,8 @@ def main() -> int:
             ready = work / f"ready-{s}"
             workers.append(_spawn_worker(
                 addr, work, s, n_events, args.seed,
-                ready=str(ready), go=str(go)))
+                ready=str(ready), go=str(go),
+                fleet_push=collector.address))
         deadline = time.time() + 300
         for s in range(K):
             while not (work / f"ready-{s}").exists():
@@ -212,7 +234,8 @@ def main() -> int:
 
         # Takeover worker: same id, same chain dir, higher incarnation.
         takeover = _spawn_worker(addr, work, KILLED, n_events,
-                                 args.seed, takeover=True)
+                                 args.seed, takeover=True,
+                                 fleet_push=collector.address)
         workers.append(takeover)
 
         # Wait for every worker to finish (w0/w2 drain + exit; the
@@ -373,7 +396,8 @@ def main() -> int:
             pass
         broker_proc.kill()
         broker_proc.wait()
-        obs.disable()  # writes the final exposition block
+        obs.disable()  # writes the final exposition block + last push
+        collector.stop()  # flushes FLEET.json + the stitched trace
 
     # Gate D: doctor over the aggregator's prom artifact.
     doctor = subprocess.run(
@@ -382,8 +406,39 @@ def main() -> int:
          str(args.merge_lag_ceiling)], cwd=str(REPO))
     if doctor.returncode != 0:
         return _fail(f"doctor exited {doctor.returncode}")
+
+    # Gate E: doctor --fleet over the collected artifact dir — ONE
+    # verdict table with per-role rows and the fleet-wide merge-lag
+    # gate judged over the MERGED data (exit 1 on breach).
+    doctor = subprocess.run(
+        [sys.executable, "-m", "attendance_tpu.cli", "doctor",
+         "--fleet", str(fleet_dir), "--merge-lag-ceiling",
+         str(args.merge_lag_ceiling)], cwd=str(REPO))
+    if doctor.returncode != 0:
+        return _fail(f"doctor --fleet exited {doctor.returncode}")
+
+    # Gate F: the stitched Perfetto export crosses the process
+    # boundary — at least one aggregator fed_merge span must parent
+    # under a WORKER's fence_publish span (the traceparent rode the
+    # gossip frame header).
+    trace = json.loads((fleet_dir / "fleet_trace.json").read_text())
+    slices = [e for e in trace.get("traceEvents", [])
+              if e.get("ph") == "X"]
+    fences = {e["args"]["span_id"]: e for e in slices
+              if e["name"] == "fence_publish"}
+    merges = [e for e in slices if e["name"] == "fed_merge"]
+    stitched = [e for e in merges
+                if e["args"].get("parent_span_id") in fences]
+    if not stitched:
+        return _fail(
+            f"no fed_merge span parents under a fence_publish span "
+            f"({len(merges)} merges, {len(fences)} fences collected) "
+            "— federated trace stitching broke")
+    print(f"[soak] gate F: {len(stitched)}/{len(merges)} fed_merge "
+          "spans stitched under worker fence_publish spans",
+          flush=True)
     print("PASS: federation soak (dead-peer takeover, oracle-equal "
-          "merged state, zero false negatives, doctor gates)",
+          "merged state, zero false negatives, doctor + fleet gates)",
           flush=True)
     return 0
 
