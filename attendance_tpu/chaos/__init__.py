@@ -14,7 +14,8 @@ faults use ``fault=duration:prob``)::
 
     drop=0.01,delay=5ms:0.05,dup=0.005,conn_reset=0.002,
     persist_fail=0.01,writer_stall=200ms:0.01,corrupt=0.001,
-    snap_fail=0.01
+    snap_fail=0.01,disk_corrupt=0.01,torn_write=0.01,enospc=0.01,
+    partition=2s:0.005
 
 ``off`` parses to a spec with every probability zero — the fault plane
 is INSTALLED (every hook runs against a live injector) but never fires;
@@ -49,6 +50,26 @@ Fault points (see README "Failure model" for the full table):
 * ``persist.insert`` — ``persist_fail`` raises :class:`PersistFault`
   from the event-store insert (exercising the circuit breaker +
   spill-to-disk remediation, storage/resilient.py).
+* ``disk.chain`` / ``disk.spill`` — the durable-write seam
+  (utils/integrity hooks inside the shared fsync'd writers):
+  ``enospc`` raises OSError(ENOSPC) BEFORE any bytes land (the
+  full-disk class the snapshot writer treats distinctly);
+  ``disk_corrupt`` flips one mid-file byte AFTER the fsync'd publish
+  (the write path believed it succeeded — storage ROT, which only
+  digest verification / ``scrub`` can notice); ``torn_write``
+  truncates the published file to half (a torn sector). The injector
+  keeps a ledger of every disk fault's path (``disk_faults``) so a
+  soak can prove scrub detects 100% of the injections that survive
+  on disk.
+* ``transport.consume`` / ``fed.gossip`` — ``partition``
+  (``partition=dur:p``): a one-way network blackhole window. On the
+  consume side the consumer sees SILENCE for the duration (receives
+  time out; the broker retains everything, so delivery resumes on
+  heal). On the gossip side the publisher's frames vanish without an
+  error (gossip is fire-and-forget by design; convergence recovers
+  from the next full frame / end-of-run ``fed_flush``). Both model a
+  partition's observable behavior rather than a socket error — the
+  error classes are what ``drop``/``conn_reset`` already cover.
 """
 
 from __future__ import annotations
@@ -63,8 +84,8 @@ from random import Random
 from typing import Dict, Optional, Tuple
 
 _PROB_FAULTS = ("drop", "dup", "conn_reset", "persist_fail", "corrupt",
-                "snap_fail")
-_TIMED_FAULTS = ("delay", "writer_stall")
+                "snap_fail", "disk_corrupt", "torn_write", "enospc")
+_TIMED_FAULTS = ("delay", "writer_stall", "partition")
 
 _DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|us)?$")
 
@@ -110,10 +131,15 @@ class ChaosSpec:
     persist_fail: float = 0.0
     corrupt: float = 0.0
     snap_fail: float = 0.0
+    disk_corrupt: float = 0.0   # post-fsync bit flip (storage rot)
+    torn_write: float = 0.0     # post-fsync truncation (torn sector)
+    enospc: float = 0.0         # OSError(ENOSPC) at the writer seam
     delay: float = 0.0          # probability
     delay_s: float = 0.0        # duration per hit
     writer_stall: float = 0.0   # probability
     writer_stall_s: float = 0.0
+    partition: float = 0.0      # probability a blackhole window opens
+    partition_s: float = 0.0    # window duration
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosSpec":
@@ -162,6 +188,12 @@ class ChaosInjector:
         self._streams: Dict[Tuple[str, str], Random] = {}
         # (site, fault) -> injected count: the soak's ground truth.
         self.injected: Dict[Tuple[str, str], int] = {}
+        # Storage-rot ledger: every (site, fault, path) a disk fault
+        # touched — the scrub soak's "every injected corruption is
+        # detected" proof is judged against the paths still on disk.
+        self.disk_faults: list = []
+        # site -> monotonic deadline of an open partition window.
+        self._blackhole_until: Dict[str, float] = {}
         self._obs_counters: Dict[Tuple[str, str], object] = {}
 
     def _rng(self, site: str, fault: str) -> Random:
@@ -219,6 +251,40 @@ class ChaosInjector:
         """Injected writer stall at ``site`` (0.0 = none)."""
         return (self.spec.writer_stall_s
                 if self.roll(site, "writer_stall") else 0.0)
+
+    def note_disk_fault(self, site: str, fault: str, path,
+                        digest: str = "") -> None:
+        """Record which durable artifact a disk fault mangled, plus
+        the file's POST-fault digest — a soak proves scrub detects
+        every injection whose rot is still on disk (a later clean
+        rewrite of the same path, e.g. a manifest, heals it)."""
+        with self._lock:
+            self.disk_faults.append((site, fault, str(path), digest))
+
+    def blackhole(self, site: str) -> bool:
+        """Is ``site`` inside a ``partition`` blackhole window? Each
+        call outside a window rolls ``partition``; a hit opens a
+        window of ``partition_s`` during which every call answers
+        True (messages silently vanish / receives see silence)."""
+        if self.spec.partition <= 0.0:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            if now < self._blackhole_until.get(site, 0.0):
+                return True
+        if self.roll(site, "partition"):
+            with self._lock:
+                self._blackhole_until[site] = now + self.spec.partition_s
+            return True
+        return False
+
+    def in_blackhole(self, site: str) -> bool:
+        """Read-only: is a partition window currently open at
+        ``site``? (No roll — drivers use this to detect that a send
+        they just made may have been swallowed.)"""
+        with self._lock:
+            return time.monotonic() < self._blackhole_until.get(site,
+                                                                0.0)
 
     def injected_total(self, fault: Optional[str] = None) -> int:
         with self._lock:
@@ -285,6 +351,21 @@ def _producer_send_many(proxy, inner_send_many, datas, properties=None):
     return result
 
 
+def _maybe_partition_consume(inj, timeout_millis) -> None:
+    """Consume-side partition: inside a blackhole window the consumer
+    observes SILENCE — the receive waits out (a bounded slice of) its
+    timeout and raises ReceiveTimeout, exactly what a healthy broker
+    with nothing to deliver looks like. The broker retains every
+    message, so delivery resumes when the window closes."""
+    if not inj.blackhole("transport.consume"):
+        return
+    from attendance_tpu.transport.memory_broker import ReceiveTimeout
+    wait = 0.05 if timeout_millis is None else timeout_millis / 1000.0
+    time.sleep(min(wait, 0.25))
+    raise ReceiveTimeout("chaos partition: transport.consume is "
+                         "blackholed")
+
+
 def _corrupt_tuples(inj, toks):
     out = []
     for mid, data, red, props in toks:
@@ -296,6 +377,7 @@ def _corrupt_tuples(inj, toks):
 def _consumer_receive(proxy, inner_receive,
                       timeout_millis=None):
     inj = proxy._inj
+    _maybe_partition_consume(inj, timeout_millis)
     d = inj.delay_s("transport.consume")
     if d:
         time.sleep(d)
@@ -310,6 +392,7 @@ def _consumer_receive(proxy, inner_receive,
 
 def _consumer_receive_many(proxy, inner, max_n, timeout_millis=None):
     inj = proxy._inj
+    _maybe_partition_consume(inj, timeout_millis)
     msgs = inner(max_n, timeout_millis=timeout_millis)
     if not inj.active("corrupt"):
         return msgs
@@ -326,12 +409,14 @@ def _consumer_receive_many(proxy, inner, max_n, timeout_millis=None):
 
 def _consumer_receive_many_raw(proxy, inner, max_n, timeout_millis=None):
     inj = proxy._inj
+    _maybe_partition_consume(inj, timeout_millis)
     toks = inner(max_n, timeout_millis=timeout_millis)
     return _corrupt_tuples(inj, toks) if inj.active("corrupt") else toks
 
 
 def _consumer_receive_chunk(proxy, inner, max_n, timeout_millis=None):
     inj = proxy._inj
+    _maybe_partition_consume(inj, timeout_millis)
     cid, toks = inner(max_n, timeout_millis=timeout_millis)
     return (cid, _corrupt_tuples(inj, toks)
             if inj.active("corrupt") else toks)

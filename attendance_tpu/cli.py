@@ -637,7 +637,9 @@ def cmd_doctor(args) -> None:
     exposition file, an --alert-log JSONL, a flight-recorder dump,
     and/or a --trace-out export, print a pass/fail verdict table, and
     exit non-zero on an SLO breach — the run's own telemetry artifacts
-    become a CI gate without rerunning anything. ``--quarantine DIR``
+    become a CI gate without rerunning anything. ``--scrub DIR`` folds
+    the offline integrity scrub (chain/spill/quarantine digest
+    verification) into the verdict. ``--quarantine DIR``
     lists the on-disk dead-letter quarantine in the verdict;
     ``--replay-quarantine`` republishes its frames through the
     configured transport (the recovery half of the DLQ). Exit codes:
@@ -691,12 +693,29 @@ def cmd_doctor(args) -> None:
             logger.error("unreadable fleet artifacts: %s", e)
             sys.exit(2)
         print(text)
-        if not args.artifacts and not args.quarantine:
+        if not args.artifacts and not args.quarantine \
+                and not args.scrub:
             sys.exit(0 if ok else 1)
         elif not ok:
-            # Fall through to the artifact report, but remember the
+            # Fall through to the remaining reports, but remember the
             # fleet breach for the combined exit code.
             args._fleet_failed = True
+    if args.scrub:
+        # Integrity scrub rides the doctor verdict: the run's own
+        # durable artifacts (chains, spill, quarantine) must verify.
+        from attendance_tpu.utils.integrity import scrub_report
+
+        try:
+            text, ok = scrub_report(args.scrub)
+        except FileNotFoundError as e:
+            logger.error("no such scrub target: %s", e)
+            sys.exit(2)
+        print(text)
+        if not args.artifacts and not args.quarantine:
+            sys.exit(0 if ok and not getattr(args, "_fleet_failed",
+                                             False) else 1)
+        elif not ok:
+            args._scrub_failed = True
     if not args.artifacts and not args.quarantine:
         logger.error("doctor needs artifacts and/or --quarantine DIR")
         sys.exit(2)
@@ -718,7 +737,29 @@ def cmd_doctor(args) -> None:
         logger.error("unreadable artifacts: %s", e)
         sys.exit(2)
     print(text)
-    if not ok or getattr(args, "_fleet_failed", False):
+    if not ok or getattr(args, "_fleet_failed", False) \
+            or getattr(args, "_scrub_failed", False):
+        sys.exit(1)
+
+
+def cmd_scrub(args) -> None:
+    """Offline integrity scrub (the read-only half of the repair
+    ladder): verify every durable artifact under the given
+    directories against its recorded digest and print a verdict
+    table. Exit codes: 0 = nothing corrupt (legacy/orphan rows are
+    tolerated, exactly as restore tolerates them), 1 = at least one
+    CORRUPT artifact, 2 = unreadable paths."""
+    import sys
+
+    from attendance_tpu.utils.integrity import scrub_report
+
+    try:
+        text, ok = scrub_report(args.dirs)
+    except FileNotFoundError as e:
+        logger.error("no such scrub target: %s", e)
+        sys.exit(2)
+    print(text)
+    if not ok:
         sys.exit(1)
 
 
@@ -937,6 +978,12 @@ def main(argv=None) -> None:
                        "(--fleet-dir): every <role>@<instance>.prom "
                        "gets per-role rows, plus fleet-wide merge-lag"
                        "/staleness gates over the merged data")
+    p_doc.add_argument("--scrub", action="append", default=None,
+                       metavar="DIR",
+                       help="also run the offline integrity scrub "
+                       "over DIR (repeatable) and fold its verdict "
+                       "into the doctor exit code — any CORRUPT "
+                       "artifact fails the run")
     p_doc.add_argument("--quarantine", default="",
                        help="list this on-disk dead-letter quarantine "
                        "in the verdict table")
@@ -948,6 +995,18 @@ def main(argv=None) -> None:
                        help="delete quarantine entries after a "
                        "successful replay publish")
     p_doc.set_defaults(fn=cmd_doctor)
+
+    p_scr = sub.add_parser(
+        "scrub", help="offline integrity scrub: walk snapshot-chain / "
+        "spill / quarantine directories, verify every artifact "
+        "against its recorded digest, and emit a verdict table "
+        "(exit 1 on any corruption, 2 on unreadable paths)")
+    p_scr.add_argument("dirs", nargs="+", metavar="DIR",
+                       help="directories to scrub (chain dirs, spill "
+                       "dirs, quarantine dirs, or workdirs holding "
+                       "several — artifact families are auto-"
+                       "detected, subdirectories included)")
+    p_scr.set_defaults(fn=cmd_scrub)
 
     p_par = sub.add_parser(
         "parity", help="differential tpu-vs-oracle accuracy check "

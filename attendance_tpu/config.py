@@ -294,6 +294,16 @@ class Config:
     # lecture day — unbounded on a long multi-day run without a cap.
     # <= 0 disables the guard.
     metric_series_max: int = 1024
+    # Storage-integrity plane (utils/integrity): when on (the
+    # default), every durable chain artifact's payload digest is
+    # recorded in its manifest (CHAIN.json base_digest/digests,
+    # MANIFEST.json digests) and verified before restore / the serve
+    # chain readers trust a file; spill records carry per-record
+    # checksums; gossip merge frames and fleet pushes ride the
+    # checksummed wire framing. False skips digest COMPUTATION at the
+    # writers (the bench's integrity-off baseline) — verification
+    # still runs wherever digests already exist on disk.
+    integrity: bool = True
     # Total retry budget for one logical broker RPC over the socket
     # transport: transient failures reconnect + retry with jittered
     # exponential backoff inside this window, then surface ONE
@@ -566,6 +576,10 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    help="label-cardinality cap per metric name "
                    "(<= 0 = unlimited); overflow folds into an "
                    "unexported sink and logs once at ERROR")
+    p.add_argument("--no-integrity", action="store_true",
+                   help="skip payload-digest computation at the "
+                   "durable writers (bench baseline; verification "
+                   "still runs where digests exist on disk)")
     p.add_argument("--retry-budget-s", type=float,
                    default=d.retry_budget_s,
                    help="total reconnect+retry window per broker RPC "
@@ -672,6 +686,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         fleet_port=args.fleet_port,
         fleet_dir=args.fleet_dir,
         metric_series_max=args.metric_series_max,
+        integrity=not args.no_integrity,
         retry_budget_s=args.retry_budget_s,
         serve_port=args.serve_port,
         query_batch_max=args.query_batch_max,

@@ -6,7 +6,17 @@ fence: either the dirty-bank DELTA the PR 4 capture already gathered
 state (``kind="full"``: packed Bloom words + every register bank —
 preload, restore, base snapshots, and chain recovery publish these),
 plus zero-array ``heartbeat`` frames that keep peer liveness observable
-between fences.
+between fences and zero-array ``repair_request`` frames (the
+storage-rot repair ladder: a worker whose chain restore hit a corrupt
+artifact asks the aggregator to re-assert its own retained
+contribution as a full frame on ``<topic>.reassert.<worker>``).
+
+Wire integrity: every frame publishes through the checksummed framing
+variant (``transport.framing.enc_checksummed`` — magic + sha256 +
+body), so in-flight rot is rejected loudly at the fold instead of
+OR-ing mangled words into the merged view. Legacy un-wrapped frames
+still decode (one warning per worker — the same tolerance pattern as
+the ``traceparent`` field below).
 
 Wire layout (little-endian), built on :mod:`transport.framing` — the
 gossip wire is the framing module's fourth user, not a fourth copy:
@@ -50,7 +60,7 @@ from attendance_tpu.transport.framing import (
 
 FRAME_VERSION = 1
 
-KINDS = ("full", "delta", "heartbeat")
+KINDS = ("full", "delta", "heartbeat", "repair_request")
 
 _U16 = struct.Struct("<H")
 

@@ -129,7 +129,8 @@ class FenceGossip:
                     "attendance_fed_gossip_frames_total",
                     help="Merge frames published to the gossip topic",
                     kind=kind, worker=self.worker)
-                for kind in ("full", "delta", "heartbeat")}
+                for kind in ("full", "delta", "heartbeat",
+                             "repair_request")}
             self._c_failures = obs.registry.counter(
                 "attendance_fed_gossip_failures_total",
                 help="Gossip publishes that failed (the next "
@@ -151,12 +152,23 @@ class FenceGossip:
         """``encode`` builds the payload (allocating its seq) UNDER the
         send lock: seq order must equal wire order, or a heartbeat
         racing a fence would make the aggregator call the real delta
-        stale."""
+        stale. Every frame ships through the checksummed framing
+        variant so the aggregator can reject in-flight rot at the
+        fold. A chaos ``partition`` blackhole swallows the frame
+        SILENTLY (the publisher believes success — gossip loss is the
+        fire-and-forget model; convergence recovers from the next
+        full frame / fed_flush)."""
+        from attendance_tpu import chaos
+        from attendance_tpu.transport.framing import enc_checksummed
+
         try:
             with self._lock:
                 if self._closed:
                     return False
-                self._producer.send(encode())
+                data = enc_checksummed(encode())
+                inj = chaos.get()
+                if inj is None or not inj.blackhole("fed.gossip"):
+                    self._producer.send(data)
         except Exception:
             if self._c_failures is not None:
                 self._c_failures.inc()
@@ -227,6 +239,68 @@ class FenceGossip:
         return self._send("heartbeat", lambda: self._encode(
             "heartbeat", self._last_events))
 
+    def request_reassert(self, timeout_s: float = 10.0
+                         ) -> Optional[MergeFrame]:
+        """The repair ladder's peer-assist rung: publish a
+        ``repair_request`` on the gossip topic and wait (bounded) for
+        the aggregator to re-assert this worker's own retained
+        contribution as a full frame on the per-worker reply topic.
+        Returns the frame, or None (no aggregator / timeout / the
+        request itself was lost) — the caller then repairs locally
+        only."""
+        from attendance_tpu.transport.framing import (
+            FrameChecksumError, dec_checksummed)
+        from attendance_tpu.transport.memory_broker import (
+            ReceiveTimeout)
+
+        reply_topic = f"{self.topic}.reassert.{self.worker}"
+        try:
+            consumer = self._client.subscribe(
+                reply_topic, f"reassert-{self.worker}")
+        except Exception:
+            logger.warning("cannot subscribe the re-assert reply "
+                           "topic; repairing locally only",
+                           exc_info=True)
+            return None
+        try:
+            if not self._send("repair_request", lambda: self._encode(
+                    "repair_request", self._last_events)):
+                return None
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    msg = consumer.receive(timeout_millis=500)
+                except ReceiveTimeout:
+                    continue
+                except Exception:
+                    logger.warning("re-assert receive failed; "
+                                   "repairing locally only",
+                                   exc_info=True)
+                    return None
+                try:
+                    body, _ = dec_checksummed(bytes(msg.data()))
+                    frame = decode_frame(body)
+                except (FrameChecksumError, ValueError):
+                    logger.warning("undecodable re-assert frame "
+                                   "skipped", exc_info=True)
+                    consumer.acknowledge(msg)
+                    continue
+                consumer.acknowledge(msg)
+                if frame.kind == "full" and \
+                        frame.worker == self.worker:
+                    logger.info("peer re-assert received: %d events, "
+                                "%d banks", frame.events,
+                                len(frame.bank_of))
+                    return frame
+            logger.warning("peer re-assert timed out after %.1fs; "
+                           "repairing locally only", timeout_s)
+            return None
+        finally:
+            try:
+                consumer.close()
+            except Exception:
+                pass
+
     def close(self) -> None:
         self._hb_stop.set()
         if self._hb_thread is not None:
@@ -275,6 +349,12 @@ class Aggregator:
                                                GOSSIP_SUBSCRIPTION)
         self._down: set = set()
         self._no_traceparent_warned: set = set()
+        self._no_checksum_warned: set = set()
+        # Checksum-reject retries bounded by THIS frame's own failure
+        # count, not the broker redelivery count (which reconnect/
+        # takeover requeues inflate — the PoisonTracker lesson).
+        from attendance_tpu.transport import PoisonTracker
+        self._poison = PoisonTracker()
         self.recovered_chains: Dict[str, int] = {}
         self.geometry_rejects = 0
         self._stop = threading.Event()
@@ -283,6 +363,13 @@ class Aggregator:
         self._tracer = obs.tracer if obs is not None else None
         self._h_lag = self._c_deltas = self._c_stale = None
         self._c_takeovers = self._g_peers = self._c_geom = None
+        self._c_wire = None
+        if obs is not None:
+            self._c_wire = obs.registry.counter(
+                "attendance_integrity_wire_rejects_total",
+                help="Frames rejected for a failed payload checksum "
+                     "(in-flight rot, never folded)",
+                site="fed.gossip")
         if obs is not None:
             self._h_lag = obs.registry.histogram(
                 "attendance_fed_merge_lag_seconds",
@@ -398,6 +485,9 @@ class Aggregator:
         from attendance_tpu.transport.memory_broker import (
             ReceiveTimeout)
 
+        from attendance_tpu.transport.framing import (
+            FrameChecksumError, dec_checksummed)
+
         try:
             msgs = self.consumer.receive_many(64,
                                               timeout_millis=timeout_ms)
@@ -405,10 +495,64 @@ class Aggregator:
             return 0
         folded = 0
         for msg in msgs:
+            raw = bytes(msg.data())
             try:
-                frame = decode_frame(bytes(msg.data()))
+                body, verified = dec_checksummed(raw)
+            except FrameChecksumError:
+                # In-flight rot, rejected AT THE FOLD: the broker
+                # still holds the original bytes, so a bounded nack
+                # redelivers them clean; past the bound the frame is
+                # dropped (counted) rather than folded mangled. The
+                # bound is the frame's OWN failure count (PoisonTracker
+                # — broker redelivery counts are inflated by
+                # reconnect/takeover requeues, so a once-corrupted
+                # frame under connection churn would otherwise drop
+                # without a single clean retry).
+                if self._c_wire is not None:
+                    self._c_wire.inc()
+                mid = msg.message_id
+                mid = mid() if callable(mid) else mid
+                failures = self._poison.bump(mid)
+                if failures <= 3:
+                    logger.error(
+                        "gossip frame failed its checksum (attempt "
+                        "%d); nacking for clean redelivery", failures)
+                    try:
+                        self.consumer.negative_acknowledge(msg)
+                        continue
+                    except Exception:
+                        logger.exception("nack failed; dropping the "
+                                         "rotten frame")
+                else:
+                    logger.error(
+                        "gossip frame failed its checksum %d times; "
+                        "dropping it (never folded)", failures)
+                self._poison.forget(mid)
+                self.consumer.acknowledge(msg)
+                continue
+            try:
+                frame = decode_frame(body)
             except Exception:
                 logger.exception("undecodable gossip frame dropped")
+                self.consumer.acknowledge(msg)
+                continue
+            if not verified and \
+                    frame.worker not in self._no_checksum_warned:
+                # An older worker predating the checksummed wire:
+                # fold normally, say so ONCE per worker (the same
+                # tolerance pattern as the traceparent field).
+                self._no_checksum_warned.add(frame.worker)
+                logger.warning(
+                    "gossip frames from %s carry no payload checksum "
+                    "(older worker build?) — folding normally, but "
+                    "in-flight rot on this peer's frames is "
+                    "undetectable", frame.worker)
+            if frame.kind == "repair_request":
+                try:
+                    self._serve_reassert(frame)
+                except Exception:
+                    logger.exception("re-assert for %s failed",
+                                     frame.worker)
                 self.consumer.acknowledge(msg)
                 continue
             try:
@@ -429,6 +573,66 @@ class Aggregator:
         if folded:
             self.publish_epoch()
         return folded
+
+    def _serve_reassert(self, request: MergeFrame) -> bool:
+        """Serve a worker's ``repair_request``: re-publish that
+        worker's OWN retained contribution (Bloom-OR of its frames,
+        register-max of its rows — never the global view, which would
+        fatten the worker's filter with other shards' keys and skew
+        its post-repair false-positive admissions) as a full frame on
+        ``<topic>.reassert.<worker>``. Returns whether a frame was
+        sent."""
+        from attendance_tpu.federation.merge import encode_counts
+        from attendance_tpu.transport.framing import enc_checksummed
+
+        worker = request.worker
+        ws = self.view.worker_state.get(worker)
+        ledger = self.view.workers.get(worker)
+        if not ws or ws.get("bloom") is None or ledger is None \
+                or self.view.params is None:
+            logger.warning(
+                "repair_request from %s but no retained contribution "
+                "to re-assert (fresh aggregator, or retention off) — "
+                "the worker repairs locally only", worker)
+            return False
+        days = sorted(ws["rows"])
+        regs = (np.stack([ws["rows"][d] for d in days])
+                if days else np.zeros((0, self.view.m), np.uint8))
+        data = encode_frame(
+            worker=worker, kind="full",
+            incarnation=ledger.incarnation, seq=ledger.seq,
+            shard=ledger.shard, fence_ts=time.time(),
+            events=ledger.events,
+            bank_of={d: i for i, d in enumerate(days)},
+            m_bits=self.view.params.m_bits, k=self.view.params.k,
+            precision=self.view.precision,
+            num_banks=regs.shape[0],
+            roster_size=ledger.roster_size,
+            snapshot_dir=ledger.snapshot_dir, traceparent="",
+            arrays={"bloom": np.asarray(ws["bloom"], np.uint32),
+                    "regs": np.asarray(regs, np.uint8),
+                    "counts": encode_counts(ledger.valid,
+                                            ledger.invalid)})
+        reply_topic = f"{self.topic}.reassert.{worker}"
+        producer = self._client.create_producer(reply_topic)
+        try:
+            producer.send(enc_checksummed(data))
+        finally:
+            try:
+                producer.close()
+            except Exception:
+                pass
+        if self._obs is not None:
+            self._obs.registry.counter(
+                "attendance_fed_reasserts_total",
+                help="Peer-assisted chain repairs served (full-frame "
+                     "re-asserts of a worker's retained contribution)"
+            ).inc()
+        logger.warning(
+            "served re-assert to %s: %d events, %d banks (chain "
+            "repair in progress on the worker)", worker,
+            ledger.events, len(days))
+        return True
 
     # -- liveness + failover -------------------------------------------------
     def check_liveness(self, now: Optional[float] = None) -> list:

@@ -40,6 +40,17 @@ class GeometryMismatch(ValueError):
     error-rate / layout / precision differ across the federation)."""
 
 
+def encode_counts(valid: int, invalid: int) -> np.ndarray:
+    """(valid, invalid) re-encoded as the two-lane uint32[2, 2] the
+    epoch/stats/frame surfaces decode (decode_counts's inverse)."""
+    out = np.zeros((2, 2), np.uint32)
+    out[0, 0] = valid & 0xFFFFFFFF
+    out[0, 1] = valid >> 32
+    out[1, 0] = invalid & 0xFFFFFFFF
+    out[1, 1] = invalid >> 32
+    return out
+
+
 class _WorkerLedger:
     """Per-worker-id cumulative-counter state, newest-incarnation-wins."""
 
@@ -69,7 +80,8 @@ class MergedView:
     epochs through serve.mirror, so readers never see this object).
     """
 
-    def __init__(self, precision: int = 14):
+    def __init__(self, precision: int = 14,
+                 retain_worker_state: bool = True):
         self.precision = precision
         self.m = 1 << precision
         self.params: Optional[BloomParams] = None
@@ -77,6 +89,16 @@ class MergedView:
         self.bank_of: Dict[int, int] = {}  # day -> global bank
         self.regs = np.zeros((8, self.m), np.uint8)
         self.workers: Dict[str, _WorkerLedger] = {}
+        # Per-worker CRDT retention (the storage-rot repair ladder's
+        # peer-assist source): each worker's OWN contribution —
+        # Bloom-OR of its frames' words, register-max of its rows by
+        # day. Re-asserting THIS (not the global view) to a repairing
+        # worker keeps its local filter exactly its shard's filter, so
+        # post-repair runs stay register-identical to a no-fault
+        # oracle. Costs one sketch copy per worker; switch off for
+        # aggregators that never serve repairs.
+        self.retain_worker_state = retain_worker_state
+        self.worker_state: Dict[str, dict] = {}
         self.folded_deltas = 0
         self.folded_fulls = 0
         self.stale_frames = 0
@@ -146,7 +168,7 @@ class MergedView:
                 w.invalid = max(w.invalid, invalid)
         else:
             self.stale_frames += 1
-        if frame.kind == "heartbeat":
+        if frame.kind in ("heartbeat", "repair_request"):
             return {"stale": stale, "lag_s": None}
         # Sketch state folds EVEN FROM STALE FRAMES: OR/max are
         # idempotent, so a late frame from a previous owner can only
@@ -200,8 +222,31 @@ class MergedView:
                 # Local banks are unique within a frame, so gb is
                 # unique: direct fancy-index max-merge is exact.
                 self.regs[gb] = np.maximum(self.regs[gb], sub)
+        if self.retain_worker_state and \
+                (rows.shape[0] or "bloom" in frame.arrays):
+            self._retain(frame, inv, rows, local_banks)
         return {"stale": stale,
                 "lag_s": max(0.0, now - float(frame.fence_ts))}
+
+    def _retain(self, frame: MergeFrame, inv: Dict, rows: np.ndarray,
+                local_banks) -> None:
+        """Fold this frame into the worker's OWN retained view (same
+        CRDT joins as the global fold, keyed per worker id — takeover
+        successors share the dead peer's id and therefore its
+        retained contribution, which is exactly the shard's)."""
+        ws = self.worker_state.setdefault(
+            frame.worker, {"bloom": None, "rows": {}})
+        if "bloom" in frame.arrays:
+            words = np.asarray(frame.arrays["bloom"], np.uint32)
+            ws["bloom"] = (words.copy() if ws["bloom"] is None
+                           else bloom_or_words_np(ws["bloom"], words))
+        for i, lb in enumerate(np.asarray(local_banks).tolist()):
+            day = inv.get(int(lb))
+            if day is None:
+                continue
+            cur = ws["rows"].get(int(day))
+            ws["rows"][int(day)] = (rows[i].copy() if cur is None
+                                    else np.maximum(cur, rows[i]))
 
     # -- aggregate reads -----------------------------------------------------
     @property
@@ -215,14 +260,9 @@ class MergedView:
     def counts_array(self) -> np.ndarray:
         """Aggregate (valid, invalid) re-encoded as the two-lane
         uint32[2, 2] the epoch/stats surfaces decode."""
-        valid = sum(w.valid for w in self.workers.values())
-        invalid = sum(w.invalid for w in self.workers.values())
-        out = np.zeros((2, 2), np.uint32)
-        out[0, 0] = valid & 0xFFFFFFFF
-        out[0, 1] = valid >> 32
-        out[1, 0] = invalid & 0xFFFFFFFF
-        out[1, 1] = invalid >> 32
-        return out
+        return encode_counts(
+            sum(w.valid for w in self.workers.values()),
+            sum(w.invalid for w in self.workers.values()))
 
     def epoch_fields(self) -> Dict:
         """Everything serve.mirror.ReadMirror.publish needs for the

@@ -89,7 +89,8 @@ def make_worker_config(worker: str, shard: int, num_shards: int,
                        data_plane: str = "socket",
                        snapshot_every: int = 4, gossip_topic: str = "",
                        metrics_prom: str = "", trace_out: str = "",
-                       fleet_push: str = ""):
+                       fleet_push: str = "", chaos: str = "",
+                       chaos_seed: int = 0):
     from attendance_tpu.config import Config
 
     workdir = Path(workdir)
@@ -105,6 +106,10 @@ def make_worker_config(worker: str, shard: int, num_shards: int,
         quarantine_dir=str(workdir / f"quarantine-{shard}"),
         fed_worker=worker, fed_shard=shard, fed_shards=num_shards,
         fed_gossip_broker=broker,
+        # Per-worker chaos (the federation soak's partition/rot
+        # injection rides here — each worker process gets its own
+        # seeded injector).
+        chaos=chaos, chaos_seed=chaos_seed,
         metrics_prom=metrics_prom, trace_out=trace_out,
         # Fleet plane: the worker pushes its registry + span batches
         # to the collector so the aggregator-side pane of glass (and
@@ -125,7 +130,8 @@ def run_worker(args) -> dict:
         snapshot_every=args.snapshot_every,
         gossip_topic=args.gossip_topic,
         metrics_prom=args.metrics_prom,
-        fleet_push=args.fleet_push)
+        fleet_push=args.fleet_push,
+        chaos=args.chaos, chaos_seed=args.chaos_seed)
     full, mine, frames = build_workload(
         args.seed, args.shard, args.num_shards, args.num_events,
         roster_size=args.roster_size, batch=args.batch)
@@ -182,6 +188,20 @@ def run_worker(args) -> dict:
         # holds this worker's complete final state before we exit.
         pipe.snapshot()
         pipe.fed_flush()
+        from attendance_tpu import chaos as chaos_mod
+        inj = chaos_mod.get()
+        if inj is not None and inj.spec.partition > 0:
+            # Assured final re-assert under injected partitions: a
+            # gossip blackhole swallows frames SILENTLY, and the final
+            # full frame is the federation's convergence anchor. If a
+            # window was open at (or opened by) the flush, wait it out
+            # and re-assert — CRDT full frames are idempotent, so the
+            # retries cost nothing when the first one landed.
+            for _ in range(20):
+                if not inj.in_blackhole("fed.gossip"):
+                    break
+                time.sleep(inj.spec.partition_s + 0.05)
+                pipe.fed_flush()
         measured = pipe.metrics.events - warmup
         return {
             "worker": args.worker, "shard": args.shard,
@@ -234,6 +254,11 @@ def main(argv=None) -> None:
     p.add_argument("--fleet-push", default="",
                    help="fleet collector HOST:PORT to push telemetry "
                    "to (role=worker, instance=--worker)")
+    p.add_argument("--chaos", default="",
+                   help="chaos spec for THIS worker process (e.g. "
+                   "'partition=1500ms:0.05' — the federation soak's "
+                   "fault injection)")
+    p.add_argument("--chaos-seed", type=int, default=0)
     args = p.parse_args(argv)
     report = run_worker(args)
     print(json.dumps(report), flush=True)

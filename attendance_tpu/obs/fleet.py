@@ -173,11 +173,16 @@ class FleetPusher:
             self.push_now()
 
     def _call(self, header: dict, payload: bytes) -> None:
+        from attendance_tpu.transport.framing import enc_checksummed
         from attendance_tpu.transport.resilience import resilient_call
 
         if self._rpc is None:
             self._rpc = self._rpc_factory()
-        body = enc_props(header) + payload
+        # Checksummed push frame (integrity plane): the collector
+        # verifies the digest before folding — a rotted push is
+        # REJECTED (error status), and the resilient_call retry
+        # re-sends fresh bytes, idempotent per (boot, seq).
+        body = enc_checksummed(enc_props(header) + payload)
         status, reply = resilient_call(
             self._rpc, lambda: (F_PUSH, body), site="fleet.push",
             policy=self._policy, aborted=self._stop.is_set)
@@ -309,6 +314,7 @@ class FleetCollector:
         self.flush_interval_s = flush_interval_s
         self._lock = threading.Lock()
         self._instances: Dict[str, _Instance] = {}
+        self._no_checksum_warned: set = set()
         self._last_flush = 0.0
         self._stopping = False
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -396,9 +402,26 @@ class FleetCollector:
             conn.close()
 
     def _ingest(self, body: bytes) -> None:
+        from attendance_tpu.transport.framing import dec_checksummed
+
+        # FrameChecksumError propagates: the push is REJECTED with an
+        # error status and the pusher's retry re-sends clean bytes —
+        # wire rot never reaches the merged registry. Legacy pushers
+        # (no checksum magic) fold normally, one warning per instance.
+        body, verified = dec_checksummed(body)
         header, off = dec_props(body, 0)
         if not header or "role" not in header:
             raise ValueError("malformed fleet push header")
+        if not verified:
+            key0 = (f"{header['role']}"
+                    f"@{header.get('instance', '?')}")
+            if key0 not in self._no_checksum_warned:
+                self._no_checksum_warned.add(key0)
+                logger.warning(
+                    "fleet pushes from %s carry no payload checksum "
+                    "(older pusher build?) — folding normally, but "
+                    "in-flight rot on its pushes is undetectable",
+                    key0)
         payload = body[off:]
         kind = header.get("kind")
         key = f"{header['role']}@{header.get('instance', '?')}"

@@ -714,6 +714,32 @@ def _prom_checks(text: str, fpr_ceiling: float,
     if snap_fail:
         rows.append(["snapshot write failures",
                      _fmt_value(max(snap_fail)), "-", "info"])
+    # Integrity plane (informational rows — scrub and the chaos soak
+    # are the hard gates; these surface the conditions in one place):
+    # ENOSPC snapshot refusals (the writer backs off at the capped
+    # cadence, frames stay unacked), corrupt durable artifacts
+    # detected/quarantined, repairs performed, and wire-checksum
+    # rejects at the gossip/fleet folds.
+    disk_full = _vals("attendance_snapshot_disk_full_total")
+    if disk_full and max(disk_full) > 0:
+        rows.append(["snapshot disk full (ENOSPC)",
+                     _fmt_value(max(disk_full)), "-", "info"])
+    corrupt = _vals("attendance_chain_corrupt_files_total")
+    if corrupt:
+        rows.append(["corrupt chain files quarantined",
+                     _fmt_value(sum(corrupt)), "-", "info"])
+    repairs = _vals("attendance_chain_repairs_total")
+    if repairs:
+        rows.append(["chain repairs (local + peer)",
+                     _fmt_value(sum(repairs)), "-", "info"])
+    wire_rej = _vals("attendance_integrity_wire_rejects_total")
+    if wire_rej and max(wire_rej) > 0:
+        rows.append(["wire checksum rejects",
+                     _fmt_value(sum(wire_rej)), "-", "info"])
+    spill_rot = _vals("attendance_spill_corrupt_records_total")
+    if spill_rot and max(spill_rot) > 0:
+        rows.append(["corrupt spill records dropped",
+                     _fmt_value(sum(spill_rot)), "-", "info"])
     circ = [(labels, v) for name, labels, v in samples
             if name == "attendance_circuit_state"]
     if circ:

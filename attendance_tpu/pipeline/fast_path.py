@@ -90,8 +90,23 @@ CHAIN_MANIFEST = "CHAIN.json"  # fsync'd base+delta chain manifest
 _SNAP_QUEUE_DEPTH = 2  # staged delta captures in flight (double buffer)
 
 
+def _verify_npz_structure(path, orig_exc) -> None:
+    """Full structural read of an npz (zip-CRC verification of every
+    entry, the SHARED integrity.structural_npz_check — restore and
+    scrub must reach the same verdict for the same file): the fallback
+    discriminator between a stale recorded digest (benign crash
+    window) and real storage rot. Re-raises the original classified
+    error when the file does not parse clean."""
+    from attendance_tpu.utils.integrity import structural_npz_check
+
+    if structural_npz_check(path) is not None:
+        raise orig_exc from None
+
+
 def read_chain_state(snap_dir, *, expect_m_bits: Optional[int] = None,
-                     expect_precision: Optional[int] = None) -> dict:
+                     expect_precision: Optional[int] = None,
+                     stop_before: Optional[str] = None,
+                     verified: Optional[dict] = None) -> dict:
     """Merge-on-read over a snapshot directory: the base npz plus every
     CHAIN.json-listed delta, applied in order. Shared by
     :meth:`FusedPipeline.restore` and the query plane's separate-process
@@ -106,18 +121,101 @@ def read_chain_state(snap_dir, *, expect_m_bits: Optional[int] = None,
     ValueError below, which chain readers handle by re-reading the
     manifest and retrying.
 
+    Integrity: every file with a CHAIN.json-recorded digest is
+    verified before it is trusted; failures raise a classified
+    :class:`utils.integrity.ChainIntegrityError` (kinds:
+    ``digest_mismatch`` / ``missing`` / ``torn_manifest`` /
+    ``unreadable``) — the input to the repair ladder (quarantine ->
+    truncate -> peer re-assert -> fresh base) instead of an opaque
+    numpy error or a silent wrong restore. ``stop_before`` truncates
+    the applied chain just before the named delta (the repair path's
+    "apply every delta before the corrupt one"). ``verified`` is an
+    optional caller-owned ``{file name: digest}`` cache: deltas are
+    immutable and the base is replace-only, so a (name, digest) pair
+    that verified once need not be re-hashed on every reload — the
+    serve-plane chain reader passes a persistent dict (without it,
+    each delta publish would re-read and re-digest the whole chain,
+    possibly-large base included). Each file is still verified at
+    least once per (name, digest) per cache lifetime.
+
     Raises FileNotFoundError when no base snapshot exists."""
+    from attendance_tpu.utils.integrity import (
+        ChainIntegrityError, file_digest, verify_file)
+
     snap_dir = Path(snap_dir)
     path = snap_dir / SKETCH_SNAPSHOT
     if not path.exists():
+        if (snap_dir / CHAIN_MANIFEST).exists():
+            # A manifest with no base is CORRUPTION (rot/GC of the
+            # base, or a crash inside a base-lost repair), not a
+            # never-checkpointed directory: classify it so restore
+            # enters the repair ladder (peer re-assert can rebuild)
+            # instead of silently starting fresh.
+            raise ChainIntegrityError(
+                "missing", path,
+                "chain manifest exists but the base snapshot is "
+                "absent")
         raise FileNotFoundError(f"no base snapshot at {path}")
     chain: list = []
+    chain_digests: dict = {}
+    base_digest = ""
     chain_path = snap_dir / CHAIN_MANIFEST
     if chain_path.exists():
-        chain = list(json.loads(
-            chain_path.read_text()).get("deltas", []))
-    with np.load(path) as data:
-        manifest = json.loads(bytes(data["manifest"]).decode())
+        try:
+            chain_doc = json.loads(chain_path.read_text())
+        except ValueError as exc:  # torn JSON or non-UTF8 bytes
+            raise ChainIntegrityError("torn_manifest", chain_path,
+                                      str(exc)) from exc
+        chain = list(chain_doc.get("deltas", []))
+        chain_digests = dict(chain_doc.get("digests", {}))
+        base_digest = chain_doc.get("base_digest", "")
+    if base_digest and not (verified is not None and verified.get(
+            SKETCH_SNAPSHOT) == base_digest):
+        try:
+            verify_file(path, base_digest)
+            if verified is not None:
+                verified[SKETCH_SNAPSHOT] = base_digest
+        except ChainIntegrityError as exc:
+            if exc.kind != "digest_mismatch":
+                raise
+            # The ONE legit mismatch: a crash between the base's
+            # in-place replace and the chain-manifest reset leaves
+            # CHAIN.json recording the OLD base's digest (the same
+            # window the chain_seq staleness fence below exists for).
+            # Distinguish it from rot STRUCTURALLY — the npz zip's
+            # per-entry CRCs catch bit flips and truncation — and
+            # proceed when clean, RECOMPUTING the digest so restore
+            # records (and the next manifest write persists) the
+            # digest of the base actually on disk; carrying the stale
+            # one forward would re-trip this warning on every later
+            # read and downgrade real base rot to the structural
+            # check forever.
+            _verify_npz_structure(path, exc)
+            base_digest = file_digest(path)
+            if verified is not None:
+                verified[SKETCH_SNAPSHOT] = base_digest
+            logger.warning(
+                "base snapshot digest differs from CHAIN.json but the "
+                "file verifies structurally: treating as the "
+                "crash-before-manifest-reset window, not rot (stale "
+                "deltas are fenced by chain_seq; digest re-recorded)")
+    try:
+        base_npz = np.load(path)
+    except Exception as exc:  # noqa: BLE001 — classify, never opaque
+        raise ChainIntegrityError(
+            "unreadable", path,
+            f"{type(exc).__name__}: {exc}") from exc
+    with base_npz as data:
+        try:
+            manifest = json.loads(bytes(data["manifest"]).decode())
+            bits = np.array(data["bloom_words"])
+            regs = np.array(data["hll_regs"], dtype=np.uint8)
+            counts = np.array(data["counts"] if "counts" in data
+                              else np.zeros((2, 2), np.uint32))
+        except Exception as exc:  # noqa: BLE001 — legacy base rot
+            raise ChainIntegrityError(
+                "unreadable", path,
+                f"{type(exc).__name__}: {exc}") from exc
         if (expect_m_bits is not None
                 and manifest["m_bits"] != expect_m_bits):
             raise ValueError(
@@ -130,10 +228,6 @@ def read_chain_state(snap_dir, *, expect_m_bits: Optional[int] = None,
                 f"snapshot HLL precision is {manifest['precision']} "
                 f"but config requests {expect_precision} — "
                 "register banks are not convertible across precisions")
-        bits = np.array(data["bloom_words"])
-        regs = np.array(data["hll_regs"], dtype=np.uint8)
-        counts = np.array(data["counts"] if "counts" in data
-                          else np.zeros((2, 2), np.uint32))
     bank_of_raw = manifest["bank_of"]
     events = manifest["events"]
     # Staleness fence (see _write_snapshot_files): a crash between
@@ -146,29 +240,66 @@ def read_chain_state(snap_dir, *, expect_m_bits: Optional[int] = None,
     base_seq = int(manifest.get("chain_seq", -1))
     applied: list = []
     for name in chain:
+        if name == stop_before:
+            break  # repair truncation: chain good only up to here
         dpath = snap_dir / name
-        if not dpath.exists():
-            raise ValueError(
-                f"chain manifest names {name} but the delta file "
-                "is missing — snapshot directory is corrupt")
         if int(name.split("-")[1].split(".")[0]) <= base_seq:
-            continue  # stale: older than the restored base
-        with np.load(dpath) as d:
-            dman = json.loads(bytes(d["manifest"]).decode())
+            # Stale (older than the restored base, the crash-window
+            # leftovers the chain_seq fence exists for): skipped
+            # BEFORE verification — rot in a file restore would never
+            # apply must not trigger a repair that truncates away the
+            # newer good deltas behind it.
+            continue
+        if name in chain_digests:
+            if not (verified is not None
+                    and verified.get(name) == chain_digests[name]):
+                verify_file(dpath, chain_digests[name])
+                if verified is not None:
+                    verified[name] = chain_digests[name]
+        elif not dpath.exists():
+            raise ChainIntegrityError(
+                "missing", dpath,
+                f"chain manifest names {name} but the delta file is "
+                "absent — snapshot directory is corrupt")
+        try:
+            delta_npz = np.load(dpath)
+        except FileNotFoundError as exc:
+            # The file vanished between the (possibly cache-skipped)
+            # verification and the open — the benign compaction race,
+            # which chain readers retry. Classify as 'missing', never
+            # 'unreadable' (that reads as permanent rot).
+            raise ChainIntegrityError(
+                "missing", dpath,
+                "vanished between manifest read and open "
+                "(compaction race, or a genuinely broken chain)"
+            ) from exc
+        except Exception as exc:  # noqa: BLE001 — legacy delta rot
+            raise ChainIntegrityError(
+                "unreadable", dpath,
+                f"{type(exc).__name__}: {exc}") from exc
+        with delta_npz as d:
+            try:
+                dman = json.loads(bytes(d["manifest"]).decode())
+                d_idx = np.asarray(d["bank_idx"], np.int64)
+                d_rows = np.asarray(d["regs_rows"])
+                d_counts = np.array(d["counts"], np.uint32)
+            except Exception as exc:  # noqa: BLE001
+                raise ChainIntegrityError(
+                    "unreadable", dpath,
+                    f"{type(exc).__name__}: {exc}") from exc
             nb = int(dman.get("num_banks", regs.shape[0]))
             if nb > regs.shape[0]:
                 grown = np.zeros((nb, regs.shape[1]), np.uint8)
                 grown[:regs.shape[0]] = regs
                 regs = grown
-            idx = np.asarray(d["bank_idx"], np.int64)
-            if len(idx):
-                if int(idx.max()) >= regs.shape[0]:
+            if len(d_idx):
+                if int(d_idx.max()) >= regs.shape[0]:
                     raise ValueError(
-                        f"delta {name} writes bank {int(idx.max())}"
+                        f"delta {name} writes bank {int(d_idx.max())}"
                         f" but the chain only restored "
                         f"{regs.shape[0]} banks — chain is corrupt")
-                regs[idx] = d["regs_rows"]
-            counts = np.array(d["counts"], np.uint32)
+                regs[d_idx] = d_rows
+            counts = d_counts
             bank_of_raw = dman["bank_of"]
             events = dman["events"]
         applied.append(name)
@@ -190,7 +321,18 @@ def read_chain_state(snap_dir, *, expect_m_bits: Optional[int] = None,
                 "registers are from different snapshots")
     return dict(bits=bits, regs=regs, counts=counts,
                 bank_of=bank_of_raw, events=events, applied=applied,
-                manifest=manifest)
+                manifest=manifest, base_digest=base_digest,
+                digests={n: chain_digests[n] for n in applied
+                         if n in chain_digests})
+
+
+class _StaleBaseError(RuntimeError):
+    """A staged delta failed the no-durable-base guard — pure
+    bookkeeping, no disk was touched, so it must not extend the
+    writer's disk-backoff meter (after an ENOSPC base failure the
+    queued deltas insta-fail on this guard; charging each one a full
+    capped backoff starves the hot loop into its idle timeout while a
+    healthy backlog still queues)."""
 
 
 class _ScatterValidity:
@@ -403,6 +545,14 @@ class FusedPipeline:
         self._writer_base_ok = False
         self._snap_chain: list = []  # delta files since the base
         self._delta_seq = 0
+        # Integrity plane (utils/integrity): payload digests recorded
+        # in CHAIN.json per durable file, verified before restore /
+        # the chain readers trust them. integrity=False skips digest
+        # computation at the writer (the bench's integrity-off
+        # baseline); verification always runs when digests exist.
+        self._integrity = bool(getattr(self.config, "integrity", True))
+        self._snap_digests: Dict[str, str] = {}  # delta name -> sha256
+        self._base_digest = ""
         self._regs_mirror: Optional[np.ndarray] = None
         self._snap_take = None  # jitted dirty-row capture (lazy)
         # Async snapshot writer (the BGSAVE analogue): ONE persistent
@@ -1364,6 +1514,10 @@ class FusedPipeline:
                                      upto=upto)
         else:
             self.store.save(self._snap_dir / EVENTS_SNAPSHOT)
+        from attendance_tpu.utils.integrity import (
+            chaos_post_publish, chaos_pre_write, file_digest)
+
+        chaos_pre_write("disk.chain")
         path = self._snap_dir / SKETCH_SNAPSHOT
         tmp = path.with_suffix(".tmp")
         with open(tmp, "wb") as f:
@@ -1375,12 +1529,20 @@ class FusedPipeline:
             # cache durability is not enough for the base itself.
             f.flush()
             os.fsync(f.fileno())
+        # Digest of the CLEAN bytes, streaming off the tmp file before
+        # the publish (and before the chaos disk-rot hook can touch
+        # the published copy) — what CHAIN.json records and every
+        # reader verifies against.
+        self._base_digest = (file_digest(tmp) if self._integrity
+                             else "")
         tmp.replace(path)
+        chaos_post_publish("disk.chain", path)
         # A full base supersedes any delta chain: reset the manifest
         # FIRST (restore must never apply stale deltas on top of this
         # newer base), then delete the superseded delta files.
         old = list(self._snap_chain)
         self._snap_chain = []
+        self._snap_digests = {}
         self._write_chain_manifest()
         for name in old:
             try:
@@ -1396,10 +1558,17 @@ class FusedPipeline:
         restore, and its frames redeliver)."""
         from attendance_tpu.utils.snapshot import write_manifest_atomic
 
-        write_manifest_atomic(
-            self._snap_dir,
-            {"base": SKETCH_SNAPSHOT, "deltas": list(self._snap_chain)},
-            name=CHAIN_MANIFEST)
+        doc = {"base": SKETCH_SNAPSHOT,
+               "deltas": list(self._snap_chain)}
+        if self._integrity:
+            # Payload digests: what restore, the serve-plane chain
+            # readers, and `scrub` verify each file against before
+            # trusting it.
+            doc["base_digest"] = self._base_digest
+            doc["digests"] = {n: self._snap_digests[n]
+                              for n in self._snap_chain
+                              if n in self._snap_digests}
+        write_manifest_atomic(self._snap_dir, doc, name=CHAIN_MANIFEST)
 
     def _write_delta_files(self, banks: np.ndarray, rows: np.ndarray,
                            counts, bank_of: dict, events: int,
@@ -1427,12 +1596,14 @@ class FusedPipeline:
         name = f"delta-{self._delta_seq:04d}.npz"
         path = self._snap_dir / name
         # fsync'd (shared helper): durable BEFORE the manifest names it.
-        fsync_write_npz(path, dict(
+        digest = fsync_write_npz(path, dict(
             bank_idx=np.asarray(banks, np.int32),
             regs_rows=np.asarray(rows, np.uint8),
             counts=np.asarray(counts, np.uint32),
             manifest=np.frombuffer(
                 json.dumps(manifest).encode(), dtype=np.uint8)))
+        if self._integrity:
+            self._snap_digests[name] = digest
         self._snap_chain.append(name)
         self._write_chain_manifest()
         return path.stat().st_size
@@ -1589,10 +1760,15 @@ class FusedPipeline:
             if pipe is None:
                 return  # frames stay unacked; process is tearing down
             backoff = pipe._writer_backoff_s()
-            if backoff:
+            if backoff and (job["kind"] == "base"
+                            or pipe._writer_base_ok):
                 # Bounded backoff BETWEEN attempts after failures (the
                 # queue slot was already released, so the hot loop
-                # keeps overlapping; only durability lags).
+                # keeps overlapping; only durability lags). Deltas
+                # staged behind a FAILED base skip it: they insta-fail
+                # the no-durable-base guard without touching the disk,
+                # and sleeping the capped backoff per doomed job
+                # starves delivery into the idle timeout.
                 time.sleep(backoff)
             pipe._run_snap_job_logged(job)
 
@@ -1621,9 +1797,25 @@ class FusedPipeline:
             self._run_snap_job(job)
             acknowledge_all(self.consumer, job["msgs"])
             self._snap_fail_streak = 0
-        except Exception:
+        except Exception as exc:
             self._base_stale = True
-            self._snap_fail_streak += 1
+            import errno as _errno
+            disk_full = (isinstance(exc, OSError)
+                         and exc.errno == _errno.ENOSPC)
+            if disk_full:
+                # ENOSPC is not a transient hiccup: walking the
+                # exponential ladder from 50ms re-attempts a FULL BASE
+                # into a full disk several times before reaching a
+                # sane cadence. Jump straight to the capped backoff
+                # and count the condition distinctly so doctor/SLOs
+                # can name it.
+                self._snap_fail_streak = max(self._snap_fail_streak + 1,
+                                             8)
+            elif not isinstance(exc, _StaleBaseError):
+                # Stale-base guard failures touched no disk: they
+                # ride whatever backoff the REAL failure earned
+                # without extending it.
+                self._snap_fail_streak += 1
             if job["kind"] == "base":
                 # The on-disk base is stale/absent: any delta job
                 # already staged behind this one must NOT chain onto
@@ -1637,10 +1829,23 @@ class FusedPipeline:
                     help="Background snapshot writes that failed "
                     "(frames stay unacked; next barrier forces a "
                     "full base)").inc()
-            logger.exception("Background snapshot failed "
-                             "(consecutive failures: %d, next attempt "
-                             "in %.2fs)", self._snap_fail_streak,
-                             self._writer_backoff_s())
+                if disk_full:
+                    obs_t.registry.counter(
+                        "attendance_snapshot_disk_full_total",
+                        help="Snapshot writes refused with ENOSPC "
+                        "(writer backs off at the capped cadence "
+                        "until space frees; frames stay unacked)"
+                    ).inc()
+            if disk_full:
+                logger.error(
+                    "Snapshot disk is FULL (ENOSPC): frames stay "
+                    "unacked, writer retries every %.1fs until space "
+                    "frees", self._writer_backoff_s())
+            else:
+                logger.exception(
+                    "Background snapshot failed (consecutive "
+                    "failures: %d, next attempt in %.2fs)",
+                    self._snap_fail_streak, self._writer_backoff_s())
         finally:
             t_done = time.perf_counter()
             stall = t_done - t0
@@ -1681,7 +1886,7 @@ class FusedPipeline:
                 self._g_chain_len.set(0.0)
             return
         if not self._writer_base_ok:
-            raise RuntimeError(
+            raise _StaleBaseError(
                 "delta capture with no durable base (an earlier base "
                 "write failed); frames stay unacked and the next "
                 "barrier writes a full base")
@@ -1872,12 +2077,23 @@ class FusedPipeline:
         frames were never acked and redeliver."""
         if self._snap_dir is None:
             return False
+        from attendance_tpu.utils.integrity import ChainIntegrityError
+        repaired = False
         try:
             chain_state = read_chain_state(
                 self._snap_dir, expect_m_bits=self.params.m_bits,
                 expect_precision=self.config.hll_precision)
         except FileNotFoundError:
             return False
+        except ChainIntegrityError as exc:
+            # The repair ladder (never a crash loop): quarantine the
+            # corrupt artifact, truncate the chain to the good prefix,
+            # fold a peer re-assert of the lost banks when federated,
+            # and owe a fresh full base at the next barrier.
+            chain_state = self._repair_chain(exc)
+            if chain_state is None:
+                return False
+            repaired = True
         bits = chain_state["bits"]
         regs = chain_state["regs"]
         counts = chain_state["counts"]
@@ -1915,6 +2131,8 @@ class FusedPipeline:
         # absent from the manifest) so a new delta never overwrites
         # one a concurrent post-mortem may read.
         self._snap_chain = applied
+        self._snap_digests = dict(chain_state.get("digests", {}))
+        self._base_digest = chain_state.get("base_digest", "")
         self._dirty_days.clear()
         self._regs_mirror = np.array(regs, dtype=np.uint8, copy=True)
         self._publish_epoch(self._regs_mirror, counts,
@@ -1932,30 +2150,304 @@ class FusedPipeline:
                 self._bloom_host, self._regs_mirror, counts,
                 dict(self._bank_of), int(events),
                 roster_size=self._roster_size)
-        self._base_stale = False
-        self._writer_base_ok = True
+        if repaired:
+            # The on-disk chain was truncated to the good prefix:
+            # publish a manifest naming ONLY the survivors (readers
+            # and scrub must stop tripping over the quarantined file)
+            # and owe a fresh full base — the repaired in-memory state
+            # is what that base persists. When the BASE itself was
+            # quarantined there is nothing servable to name: leave the
+            # manifest alone (manifest-without-base classifies as
+            # corruption on a re-read, re-entering this ladder) and
+            # let the fresh-base snapshot below publish both together.
+            if (self._snap_dir / SKETCH_SNAPSHOT).exists():
+                with self._snap_io_lock:
+                    self._write_chain_manifest()
+            self._base_stale = True
+            self._writer_base_ok = False
+        else:
+            self._base_stale = False
+            self._writer_base_ok = True
         self._delta_seq = max(
             (int(p.stem.split("-")[1])
              for p in self._snap_dir.glob("delta-*.npz")), default=0)
         segs_dir = self._snap_dir / EVENTS_SEGMENTS
         events_path = self._snap_dir / EVENTS_SNAPSHOT
         if hasattr(self.store, "load_segments") and segs_dir.is_dir():
-            self.store.truncate()
-            if hasattr(self.store, "compact_segments"):
-                # Compact BEFORE loading (restore is the safe point —
-                # no writer is running yet): a long run's cadence
-                # segments merge into one on disk, and the load below
-                # then reads that single file instead of parsing every
-                # segment twice.
-                self.store.compact_segments(segs_dir)
-            self.store.load_segments(segs_dir)
+            self._load_event_segments(segs_dir)
         elif events_path.exists():
             self.store.truncate()
-            self.store.load(events_path)
+            try:
+                self.store.load(events_path)
+            except Exception as exc:  # noqa: BLE001 — rot, classified
+                from attendance_tpu.utils.integrity import (
+                    quarantine_artifact)
+                logger.error(
+                    "events snapshot %s is unreadable (%s: %s) — "
+                    "quarantining; its rows are lost locally "
+                    "(detected, never silent)", events_path,
+                    type(exc).__name__, exc)
+                quarantine_artifact(events_path, reason="unreadable",
+                                    detail=f"{type(exc).__name__}: "
+                                    f"{exc}")
+                self.store.truncate()
+        if repaired:
+            # Rebuild the clean chain NOW (step 3 of the ladder): a
+            # fresh full base from the repaired state supersedes the
+            # truncated chain, so readers/scrub see a verifying chain
+            # immediately instead of waiting for the next barrier.
+            # Safe post-restore: load_segments marked the restored
+            # store blocks durable, so the base's save_segments call
+            # writes nothing twice. A failed write (full disk mid-
+            # repair) degrades to the normal owe-a-base path.
+            try:
+                self.snapshot()
+            except Exception:
+                logger.exception(
+                    "fresh-base write after chain repair failed; the "
+                    "next barrier retries a full base")
+                self._base_stale = True
+                self._writer_base_ok = False
         logger.info("Restored snapshot: %d events (%d deltas), "
-                    "%d HLL banks", events, len(applied),
-                    len(self._bank_of))
+                    "%d HLL banks%s", events, len(applied),
+                    len(self._bank_of),
+                    " [REPAIRED: corrupt artifact quarantined, fresh "
+                    "base written]" if repaired else "")
         return True
+
+    def _load_event_segments(self, segs_dir) -> None:
+        """Classified event-segment restore: a rotted segment file is
+        quarantined (the rows it carried are lost LOCALLY and loudly —
+        the same detect-and-bound contract as spill-record rot; read-
+        time dedup tolerates the gap) and the load retries over the
+        survivors, instead of crashing restore with an opaque numpy
+        error."""
+        from attendance_tpu.utils.integrity import (
+            quarantine_artifact, structural_npz_check)
+
+        for attempt in range(2):
+            self.store.truncate()
+            try:
+                if attempt == 0 and hasattr(self.store,
+                                            "compact_segments"):
+                    # Compact BEFORE loading (restore is the safe
+                    # point — no writer is running yet): a long run's
+                    # cadence segments merge into one on disk, and
+                    # the load below then reads that single file
+                    # instead of parsing every segment twice.
+                    self.store.compact_segments(segs_dir)
+                self.store.load_segments(segs_dir)
+                return
+            except Exception as exc:  # noqa: BLE001 — classify rot
+                bad = [p for p in sorted(
+                    Path(segs_dir).glob("segment-*.npz"))
+                    if structural_npz_check(p) is not None]
+                if not bad or attempt:
+                    raise
+                logger.error(
+                    "event segment(s) %s failed structural "
+                    "verification (%s: %s) — quarantining; their "
+                    "rows are lost locally (detected, never silent)",
+                    [p.name for p in bad], type(exc).__name__, exc)
+                for p in bad:
+                    quarantine_artifact(
+                        p, reason="unreadable",
+                        detail="event segment failed the zip-CRC "
+                               "structural check")
+
+    def _repair_chain(self, exc):
+        """The detection->repair ladder for a corrupt snapshot chain
+        (called by restore when read_chain_state classifies rot):
+
+        1. **local quarantine** — the corrupt artifact moves into
+           ``integrity-quarantine/`` with a sidecar naming why, and
+           the chain is re-read truncated to the good prefix (a torn
+           CHAIN.json degrades to base-only; a corrupt BASE leaves no
+           local state at all);
+        2. **peer re-assert** — under federation the aggregator's
+           retained per-worker CRDT view already holds the banks the
+           lost deltas carried (they were gossiped at their fences):
+           request a full-state re-assert frame and fold it on top of
+           the surviving local state;
+        3. **fresh base** — restore's caller owes a full base at the
+           next barrier, superseding the truncated chain.
+
+        Returns a ``read_chain_state``-shaped dict, or None when no
+        state is recoverable (corrupt base, no peer) — the caller
+        starts empty, loudly, with the quarantined bytes preserved
+        for triage instead of crash-looping on them."""
+        from attendance_tpu.utils.integrity import (
+            ChainIntegrityError, count_corrupt, file_digest,
+            quarantine_artifact)
+
+        state = None
+        base_lost = False
+        for _attempt in range(4):
+            kind, path = exc.kind, exc.path
+            logger.error(
+                "snapshot chain at %s is corrupt (%s at %s)%s — "
+                "quarantining and repairing", self._snap_dir, kind,
+                path.name, f": {exc.detail}" if exc.detail else "")
+            if quarantine_artifact(
+                    path, reason=kind, detail=exc.detail,
+                    expected_digest=getattr(exc, "expected",
+                                            "")) is None:
+                # Nothing on disk to move (the "missing" class):
+                # still count it — the doctor/SLO alert surface must
+                # see every detected corruption, not just the movable
+                # ones.
+                count_corrupt(kind)
+            stop = None
+            if path.name == SKETCH_SNAPSHOT:
+                base_lost = True
+            elif path.name != CHAIN_MANIFEST:
+                stop = path.name
+            if base_lost:
+                break
+            try:
+                state = read_chain_state(
+                    self._snap_dir, expect_m_bits=self.params.m_bits,
+                    expect_precision=self.config.hll_precision,
+                    stop_before=stop)
+                break
+            except ChainIntegrityError as exc2:
+                exc = exc2
+                continue
+            except FileNotFoundError:
+                break
+        if state is not None and self._integrity \
+                and not state.get("base_digest"):
+            # A torn manifest took the recorded digests with it; the
+            # base just parsed clean, so re-record its digest for the
+            # truncated manifest the caller republishes.
+            state["base_digest"] = file_digest(
+                self._snap_dir / SKETCH_SNAPSHOT)
+        reassert = None
+        folded = False
+        if self._fed is not None:
+            reassert = self._fed.request_reassert()
+        if reassert is not None:
+            state, folded = self._fold_reassert_state(state, reassert)
+        if folded:
+            self._count_repair("peer")
+        elif state is not None:
+            self._count_repair("local")
+            logger.warning(
+                "chain repaired LOCALLY only (no federation peer to "
+                "re-assert from): state truncated at the corrupt "
+                "artifact — events acked into the lost suffix are "
+                "not locally recoverable")
+        else:
+            logger.error(
+                "chain at %s is unrepairable locally (base corrupt) "
+                "and no peer re-assert is available — starting EMPTY; "
+                "the corrupt bytes are preserved under "
+                "integrity-quarantine/ for triage", self._snap_dir)
+        return state
+
+    def _count_repair(self, source: str) -> None:
+        if self._obs is not None:
+            self._obs.registry.counter(
+                "attendance_chain_repairs_total",
+                help="Corrupt-chain repairs (local truncation or "
+                     "peer-assisted re-assert)", source=source).inc()
+
+    def _fold_reassert_state(self, state, frame):
+        """Fold a peer re-assert full frame (the aggregator's retained
+        view of THIS worker's own contribution) over the surviving
+        local chain state; builds the state from scratch when the
+        base itself was lost. CRDT joins (Bloom-OR / register-max /
+        counter-max) make the fold safe regardless of how much the
+        local prefix and the re-assert overlap. Returns
+        ``(state, folded)`` — folded=False means the frame was refused
+        (geometry mismatch / unusable) and the caller must account
+        the repair as local-only, not peer-assisted."""
+        from attendance_tpu.federation.merge import encode_counts
+        from attendance_tpu.models.bloom import bloom_or_words_np
+        from attendance_tpu.models.fused import decode_counts
+
+        if int(frame.m_bits) and \
+                int(frame.m_bits) != self.params.m_bits:
+            logger.error(
+                "peer re-assert gossips a %s-bit filter, this worker "
+                "runs %s bits — refusing the repair frame",
+                frame.m_bits, self.params.m_bits)
+            return state, False
+        if int(frame.precision) != self.config.hll_precision:
+            logger.error(
+                "peer re-assert gossips precision %s, this worker "
+                "runs %s — refusing the repair frame",
+                frame.precision, self.config.hll_precision)
+            return state, False
+
+        f_regs = np.asarray(frame.arrays.get(
+            "regs", np.zeros((0, 1 << self.config.hll_precision),
+                             np.uint8)), np.uint8)
+        f_counts = frame.arrays.get("counts")
+        f_bloom = frame.arrays.get("bloom")
+        if state is None:
+            if f_bloom is None:
+                logger.error("peer re-assert carries no Bloom words; "
+                             "cannot rebuild a lost base from it")
+                return None, False
+            manifest = {
+                "bank_of": {str(d): int(b)
+                            for d, b in frame.bank_of.items()},
+                "m_bits": self.params.m_bits, "k": self.params.k,
+                "precision": self.config.hll_precision,
+                "events": int(frame.events),
+                "chain_seq": self._delta_seq,
+            }
+            state = dict(
+                bits=np.asarray(f_bloom, np.uint32),
+                regs=f_regs.copy(),
+                counts=(np.asarray(f_counts, np.uint32)
+                        if f_counts is not None
+                        else np.zeros((2, 2), np.uint32)),
+                bank_of={str(d): int(b)
+                         for d, b in frame.bank_of.items()},
+                events=int(frame.events), applied=[],
+                manifest=manifest, base_digest="", digests={})
+            logger.warning("rebuilt lost base entirely from the peer "
+                           "re-assert (%d events, %d banks)",
+                           state["events"], len(frame.bank_of))
+            return state, True
+        if f_bloom is not None:
+            state["bits"] = bloom_or_words_np(
+                np.asarray(state["bits"], np.uint32),
+                np.asarray(f_bloom, np.uint32))
+        bank_of = {int(d): int(b)
+                   for d, b in state["bank_of"].items()}
+        regs = np.asarray(state["regs"], np.uint8)
+        for day, fb in frame.bank_of.items():
+            if fb >= f_regs.shape[0]:
+                continue
+            row = f_regs[fb]
+            sb = bank_of.get(int(day))
+            if sb is None:
+                sb = len(bank_of)
+                if sb >= regs.shape[0]:
+                    grown = np.zeros((max(sb + 1, regs.shape[0] * 2),
+                                      regs.shape[1]), np.uint8)
+                    grown[:regs.shape[0]] = regs
+                    regs = grown
+                bank_of[int(day)] = sb
+                regs[sb] = row
+            else:
+                regs[sb] = np.maximum(regs[sb], row)
+        state["regs"] = regs
+        state["bank_of"] = {str(d): b for d, b in bank_of.items()}
+        lv, li = decode_counts(np.asarray(state["counts"]))
+        fv, fi = (decode_counts(np.asarray(f_counts))
+                  if f_counts is not None else (0, 0))
+        state["counts"] = encode_counts(max(lv, fv), max(li, fi))
+        state["events"] = max(int(state["events"]), int(frame.events))
+        logger.warning(
+            "folded peer re-assert over the truncated chain: events "
+            "%d, %d banks (lost deltas recovered from the "
+            "aggregator's retained view)", state["events"],
+            len(bank_of))
+        return state, True
 
     def _checkpoint_and_ack(self) -> None:
         """Barrier: materialize all in-flight outputs, make them

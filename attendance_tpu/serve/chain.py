@@ -49,6 +49,10 @@ class ChainEpochSource:
         self.refresh_s = refresh_s
         self._epoch: Optional[Epoch] = None
         self._fingerprint = None
+        # {file name: digest} verified by THIS reader: deltas are
+        # immutable and the base replace-only, so each (name, digest)
+        # pair is hashed once, not on every reload tick.
+        self._verified: dict = {}
         self._seq = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -87,8 +91,17 @@ class ChainEpochSource:
     def reload(self, force: bool = False) -> bool:
         """Load the chain if it changed since the last load; returns
         True when a new epoch was published. Retries across concurrent
-        manifest swaps (see module docstring)."""
+        manifest swaps (see module docstring).
+
+        Corruption (a digest mismatch, torn manifest, or unreadable
+        file — storage ROT, not the benign compaction race) never
+        kills the reader: the offender is quarantined, the
+        ``attendance_chain_corrupt_files_total`` counter fires so the
+        SLO engine can alert, and the reader KEEPS SERVING the last
+        good epoch until the ingest writer publishes a clean chain
+        (its own restore-repair ladder / next full base)."""
         from attendance_tpu.pipeline.fast_path import read_chain_state
+        from attendance_tpu.utils.integrity import ChainIntegrityError
 
         fp = self._chain_fingerprint()
         if not force and fp == self._fingerprint and \
@@ -97,12 +110,22 @@ class ChainEpochSource:
         last_exc: Optional[Exception] = None
         for _attempt in range(_SWAP_RETRIES):
             try:
-                state = read_chain_state(self._dir)
+                state = read_chain_state(self._dir,
+                                         verified=self._verified)
             except FileNotFoundError:
                 raise
+            except ChainIntegrityError as exc:
+                if exc.kind == "missing":
+                    # The one benign race: compaction GC'd a named
+                    # delta between our manifest read and file open.
+                    # Retry; persistent absence past the retries is
+                    # classified corruption below.
+                    last_exc = exc
+                    time.sleep(0.01)
+                    continue
+                return self._on_corrupt(exc)
             except (ValueError, OSError) as exc:
-                # A named delta vanished (compaction won the race) or
-                # the manifest itself is mid-swap: re-read and retry.
+                # The manifest itself is mid-swap: re-read and retry.
                 last_exc = exc
                 time.sleep(0.01)
                 continue
@@ -133,9 +156,47 @@ class ChainEpochSource:
                 # restart must not reset the freshness gauge/SLO.
                 published_at=self._chain_mtime())
             return True
+        from attendance_tpu.utils.integrity import ChainIntegrityError
+        if isinstance(last_exc, ChainIntegrityError):
+            # A named file stayed missing through every retry: not
+            # the compaction race, a genuinely broken chain.
+            return self._on_corrupt(last_exc)
         raise RuntimeError(
             f"chain at {self._dir} kept moving for {_SWAP_RETRIES} "
             f"read attempts: {last_exc!r}")
+
+    def _on_corrupt(self, exc) -> bool:
+        """Permanently corrupt chain: classify, quarantine the
+        offender, keep serving the last good epoch. Only a reader
+        with NO epoch at all (startup against a rotten chain) still
+        fails fast — there is nothing safe to serve."""
+        from attendance_tpu.utils.integrity import (
+            count_corrupt, quarantine_artifact)
+
+        logger.error(
+            "chain at %s is corrupt (%s at %s)%s — %s", self._dir,
+            exc.kind, exc.path.name,
+            f": {exc.detail}" if exc.detail else "",
+            "serving the last good epoch" if self._epoch is not None
+            else "no epoch served yet")
+        if exc.kind == "missing" or quarantine_artifact(
+                exc.path, reason=exc.kind, detail=exc.detail,
+                expected_digest=getattr(exc, "expected", "")) is None:
+            # Nothing on disk to quarantine (absent file, or it
+            # vanished under us): still count — the SLO alert surface
+            # must see every detected corruption.
+            count_corrupt(exc.kind)
+        if self._epoch is None:
+            raise RuntimeError(
+                f"chain at {self._dir} is corrupt ({exc.kind} at "
+                f"{exc.path.name}) and no prior epoch exists to "
+                "keep serving") from exc
+        # Remember this fingerprint: the corrupt state will not
+        # un-rot by itself, so without this every refresh tick would
+        # re-classify (and re-count) the same corruption until the
+        # writer publishes a new chain.
+        self._fingerprint = self._chain_fingerprint()
+        return False
 
     def _chain_mtime(self) -> float:
         """Publication time of the on-disk state: the newest of the

@@ -168,15 +168,25 @@ class ResilientEventStore:
         return list(payload)
 
     def _spill(self, kind: str, payload) -> None:
+        from attendance_tpu.utils.integrity import (
+            chaos_post_publish, wrap_record)
+
         self._seq += 1
         path = self.spill_dir / f"spill-{self._seq:06d}.pkl"
         blob = pickle.dumps(
             {"kind": kind, "data": self._materialize(kind, payload)},
             protocol=pickle.HIGHEST_PROTOCOL)
+        # Per-record checksum header (utils/integrity): the drain
+        # verifies before unpickling, so a record storage rot mangled
+        # is dropped loudly (redelivery covers its frames) instead of
+        # unpickling garbage into the sink. (No injected ENOSPC here:
+        # the spill IS the degraded path — full-disk chaos targets the
+        # snapshot writer seam, which has a remediation ladder.)
         with open(path, "wb") as f:
-            f.write(blob)
+            f.write(wrap_record(blob))
             f.flush()
             os.fsync(f.fileno())
+        chaos_post_publish("disk.spill", path)
         self._pending.append(path)
         self.spilled_total += 1
         if self._c_spilled is not None:
@@ -191,16 +201,26 @@ class ResilientEventStore:
     def _drain_locked(self) -> None:
         """Replay the spill backlog into the sink IN ORDER; raises on
         the first failure (the failed file stays pending)."""
+        from attendance_tpu.utils.integrity import (
+            IntegrityError, unwrap_record)
+
         while self._pending:
             path = self._pending[0]
             try:
-                blob = pickle.loads(path.read_bytes())
-            except (OSError, pickle.UnpicklingError, EOFError):
-                # A torn spill file (crash mid-write): its batch was
-                # never acked against the broker, so redelivery covers
-                # it — drop the file rather than wedging the drain.
+                payload, verified = unwrap_record(path.read_bytes())
+                blob = pickle.loads(payload)
+            except (OSError, pickle.UnpicklingError, EOFError,
+                    IntegrityError) as exc:
+                # A torn or rotted spill record (crash mid-write, or
+                # storage rot the checksum header caught): its batch
+                # was never acked against the broker, so redelivery
+                # covers it — drop the file loudly rather than
+                # wedging the drain or replaying mangled rows.
                 logger.exception("dropping unreadable spill file %s",
                                  path)
+                self._count_corrupt_record(
+                    "digest_mismatch" if isinstance(exc, IntegrityError)
+                    else "unreadable")
                 self._pending.pop(0)
                 path.unlink(missing_ok=True)
                 continue
@@ -208,6 +228,16 @@ class ResilientEventStore:
             self._pending.pop(0)
             self.drained_total += 1
             path.unlink(missing_ok=True)
+
+    def _count_corrupt_record(self, kind: str) -> None:
+        from attendance_tpu import obs
+        t = obs.get()
+        if t is not None:
+            t.registry.counter(
+                "attendance_spill_corrupt_records_total",
+                help="Spill records dropped at drain for failed "
+                     "integrity verification (frames redeliver)",
+                sink=self._sink, kind=kind).inc()
 
     # -- breaker-guarded write surface ---------------------------------------
     def _write(self, kind: str, payload) -> None:

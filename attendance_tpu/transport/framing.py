@@ -111,6 +111,45 @@ def dec_str(body: bytes, off: int) -> Tuple[str, int]:
     return body[off:off + n].decode(), off + n
 
 
+CK_MAGIC = b"CKF1"
+_CK_DIGEST_LEN = 32
+
+
+class FrameChecksumError(ValueError):
+    """A checksummed frame's payload no longer hashes to its header
+    digest — in-flight rot; reject loudly, never fold wrong bytes."""
+
+
+def enc_checksummed(body: bytes) -> bytes:
+    """The checksum-bearing frame variant (integrity plane): magic +
+    raw sha256(body) + body — ONE implementation, shared with the
+    spill-record header (utils/integrity.wrap_record; only the magic
+    differs). Used by the federation gossip wire and the fleet push
+    wire so wire rot is rejected at the fold instead of poisoning the
+    merged view. Decoders tolerate UN-wrapped legacy frames (see
+    :func:`dec_checksummed`) — same tolerance pattern as the gossip
+    traceparent field."""
+    from attendance_tpu.utils.integrity import wrap_record
+
+    return wrap_record(body, magic=CK_MAGIC)
+
+
+def dec_checksummed(data: bytes):
+    """-> (body, verified). A frame without the magic is a legacy
+    frame and passes through unverified (``verified=False`` — warn
+    once per peer, don't fail the fold); a wrapped frame whose digest
+    no longer matches raises :class:`FrameChecksumError`."""
+    from attendance_tpu.utils.integrity import (
+        IntegrityError, unwrap_record)
+
+    try:
+        return unwrap_record(data, magic=CK_MAGIC)
+    except IntegrityError as exc:
+        raise FrameChecksumError(
+            f"checksummed frame failed verification ({exc} — "
+            "in-flight corruption)") from None
+
+
 def enc_array(arr) -> bytes:
     """One numpy array with a self-describing u32-prefixed header —
     the federation merge frames' array block. dtype is the portable

@@ -27,7 +27,6 @@ so a quarantined frame still points into its span tree).
 
 from __future__ import annotations
 
-import hashlib
 import json
 import logging
 import os
@@ -35,6 +34,8 @@ import threading
 import time
 from pathlib import Path
 from typing import Dict, List, Optional
+
+from attendance_tpu.utils.integrity import bytes_digest
 
 logger = logging.getLogger(__name__)
 
@@ -103,7 +104,9 @@ class Quarantine:
             "reason": reason,
             "redeliveries": int(redeliveries),
             "bytes": len(data),
-            "sha256": hashlib.sha256(bytes(data)).hexdigest(),
+            # The shared digest spelling (utils/integrity): scrub and
+            # the replay audit verify the frame against this sidecar.
+            "sha256": bytes_digest(data),
         }
         if properties:
             meta["properties"] = dict(properties)

@@ -122,17 +122,28 @@ def _hll_precision(store) -> int:
     return getattr(store, "precision", 14)
 
 
-def fsync_write_npz(path, arrays: Dict) -> None:
+def fsync_write_npz(path, arrays: Dict, site: str = "disk.chain") -> str:
     """Durably publish one npz: tmp write + fsync + atomic rename.
     THE definition of the delta-file write for both chain layers (the
-    fused pipeline's dirty-bank deltas and the generic store chain)."""
+    fused pipeline's dirty-bank deltas and the generic store chain).
+    Returns the hex sha256 of the published bytes (computed streaming
+    off the tmp file, BEFORE the chaos disk-rot hook can mangle the
+    published copy — the recorded digest must describe clean bytes or
+    verification could never notice the rot)."""
+    from attendance_tpu.utils.integrity import (
+        chaos_post_publish, chaos_pre_write, file_digest)
+
+    chaos_pre_write(site)
     path = Path(path)
     tmp = path.with_suffix(path.suffix + ".tmp")
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
         f.flush()
         os.fsync(f.fileno())
+    digest = file_digest(tmp)
     tmp.replace(path)
+    chaos_post_publish(site, path)
+    return digest
 
 
 def fsync_dir(dir_path) -> None:
@@ -151,6 +162,10 @@ def write_manifest_atomic(dir_path, doc: Dict,
     """tmp + fsync + rename + directory fsync: the rename IS a chain
     snapshot's durability point. Shared by both chain layers (the
     fused pipeline names its manifest CHAIN.json)."""
+    from attendance_tpu.utils.integrity import (
+        chaos_post_publish, chaos_pre_write)
+
+    chaos_pre_write("disk.manifest")
     dir_path = Path(dir_path)
     path = dir_path / name
     tmp = path.with_suffix(".tmp")
@@ -160,6 +175,7 @@ def write_manifest_atomic(dir_path, doc: Dict,
         os.fsync(f.fileno())
     tmp.replace(path)
     fsync_dir(dir_path)
+    chaos_post_publish("disk.manifest", path)
 
 
 def snapshot_sketch_store(store, path) -> Dict:
@@ -190,6 +206,10 @@ def snapshot_sketch_store(store, path) -> Dict:
 
     arrays["__manifest__"] = np.frombuffer(
         json.dumps(manifest).encode(), dtype=np.uint8)
+    from attendance_tpu.utils.integrity import (
+        chaos_post_publish, chaos_pre_write, file_digest)
+
+    chaos_pre_write("disk.chain")
     tmp = path.with_suffix(path.suffix + ".tmp")
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)
@@ -198,7 +218,9 @@ def snapshot_sketch_store(store, path) -> Dict:
         # not enough for the base itself.
         f.flush()
         os.fsync(f.fileno())
+    manifest["__digest__"] = file_digest(tmp)
     tmp.replace(path)
+    chaos_post_publish("disk.chain", path)
     return manifest
 
 
@@ -219,14 +241,35 @@ def snapshot_sketch_store_chain(store, dir_path,
     dirty_all, dirty_blooms, dirty_hll = store.drain_dirty()
     try:
         manifest_path = dir_path / CHAIN_MANIFEST
-        chain = (json.loads(manifest_path.read_text())
-                 if manifest_path.exists() else None)
+        chain = None
+        if manifest_path.exists():
+            try:
+                chain = json.loads(manifest_path.read_text())
+            except ValueError as exc:
+                # (JSONDecodeError or a non-UTF8 UnicodeDecodeError
+                # — both ValueError.) The writer's OWN manifest
+                # rotted under it: the
+                # in-memory store still holds the truth, so quarantine
+                # the torn manifest and reset the chain with a fresh
+                # full base instead of crash-looping on disk state.
+                from attendance_tpu.utils.integrity import (
+                    quarantine_artifact)
+                quarantine_artifact(manifest_path,
+                                    reason="torn_manifest",
+                                    detail=str(exc))
+                dirty_all = True
         seq = (chain["seq"] if chain else 0) + 1
         if (dirty_all or chain is None
                 or len(chain.get("deltas", ())) + 1 >= compact_every):
             base = f"base-{seq:04d}.npz"
-            snapshot_sketch_store(store, dir_path / base)
-            doc = {"seq": seq, "base": base, "deltas": []}
+            base_manifest = snapshot_sketch_store(store,
+                                                  dir_path / base)
+            doc = {"seq": seq, "base": base, "deltas": [],
+                   # Payload digests (utils/integrity): restore and
+                   # scrub verify each file against these before
+                   # trusting it — the manifest is what makes disk
+                   # rot DETECTABLE instead of silently restorable.
+                   "digests": {base: base_manifest["__digest__"]}}
             write_manifest_atomic(dir_path, doc)
             _gc_chain_files(dir_path, keep={base})
             return doc
@@ -248,9 +291,10 @@ def snapshot_sketch_store_chain(store, dir_path,
                            "precision": _hll_precision(store)}
         arrays["__manifest__"] = np.frombuffer(
             json.dumps(manifest).encode(), dtype=np.uint8)
-        fsync_write_npz(dir_path / name, arrays)
+        digest = fsync_write_npz(dir_path / name, arrays)
         chain["seq"] = seq
         chain["deltas"].append(name)
+        chain.setdefault("digests", {})[name] = digest
         write_manifest_atomic(dir_path, chain)
         return chain
     except Exception:
@@ -299,18 +343,54 @@ def restore_sketch_store(store, path) -> None:
     by :func:`snapshot_sketch_store_chain` — then the manifest's base
     loads first and every listed delta is applied in order (delta
     files the manifest does not name are crash orphans and ignored).
+
+    Every chain file with a manifest-recorded digest is VERIFIED
+    before it is trusted; failures raise a classified
+    :class:`utils.integrity.ChainIntegrityError` (``digest_mismatch``
+    / ``missing`` / ``torn_manifest`` / ``unreadable``) instead of an
+    opaque numpy error — the input to the scrub/quarantine
+    remediation, never a silent wrong restore.
     """
+    from attendance_tpu.utils.integrity import (
+        ChainIntegrityError, verify_file)
+
     p = Path(path)
     if p.is_dir():
-        manifest = json.loads((p / CHAIN_MANIFEST).read_text())
-        _restore_npz(store, p / manifest["base"])
+        manifest_path = p / CHAIN_MANIFEST
+        try:
+            manifest = json.loads(manifest_path.read_text())
+        except ValueError as exc:  # torn JSON or non-UTF8 bytes
+            raise ChainIntegrityError("torn_manifest", manifest_path,
+                                      str(exc)) from exc
+        digests = manifest.get("digests", {})
+        base = manifest["base"]
+        if base in digests:
+            verify_file(p / base, digests[base])
+        try:
+            _restore_npz(store, p / base)
+        except ChainIntegrityError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — classify, not crash
+            raise ChainIntegrityError(
+                "unreadable", p / base,
+                f"{type(exc).__name__}: {exc}") from exc
         for name in manifest.get("deltas", ()):
             dpath = p / name
-            if not dpath.exists():
-                raise ValueError(
-                    f"chain manifest names {name} but the delta file "
-                    "is missing — snapshot directory is corrupt")
-            _apply_sketch_delta(store, dpath)
+            if name in digests:
+                verify_file(dpath, digests[name])
+            elif not dpath.exists():
+                raise ChainIntegrityError(
+                    "missing", dpath,
+                    "chain manifest names it but the delta file is "
+                    "absent — snapshot directory is corrupt")
+            try:
+                _apply_sketch_delta(store, dpath)
+            except ChainIntegrityError:
+                raise
+            except Exception as exc:  # noqa: BLE001
+                raise ChainIntegrityError(
+                    "unreadable", dpath,
+                    f"{type(exc).__name__}: {exc}") from exc
     else:
         _restore_npz(store, p)
     if hasattr(store, "mark_clean"):

@@ -308,6 +308,7 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
               num_banks: int, snapshot_dir: str = "",
               snapshot_every: int = 16,
               snapshot_mode: str = "delta",
+              integrity: bool = True,
               max_passes: int = CONVERGE_MAX_PASSES) -> dict:
     """Broker -> fused processor -> columnar store, wall-clock end to end.
 
@@ -333,6 +334,7 @@ def bench_e2e(batch_size: int, seconds: float, capacity: int,
                     transport_backend="memory",
                     snapshot_dir=snapshot_dir or "",
                     snapshot_mode=snapshot_mode,
+                    integrity=integrity,
                     snapshot_every_batches=snapshot_every
                     if snapshot_dir else 0)
     # Mirror production wiring (transport.make_client): when a chaos
@@ -735,6 +737,21 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
     finally:
         chaos_mod.disable()
 
+    # Integrity-plane cost: payload digests are computed at the
+    # DURABLE writers, so the honest measurement checkpoints AT RATE —
+    # two back-to-back delta-mode snapshot runs, identical but for
+    # integrity on/off (pairing adjacent runs also cancels most of a
+    # small host's between-run drift).
+    with tempfile.TemporaryDirectory() as tdir:
+        integ_off = bench_e2e(batch_size, seconds, capacity, num_banks,
+                              snapshot_dir=os.path.join(tdir, "ioff"),
+                              integrity=False)
+        integ_on = bench_e2e(batch_size, seconds, capacity, num_banks,
+                             snapshot_dir=os.path.join(tdir, "ion"),
+                             integrity=True)
+    integrity_frac = 1.0 - (integ_on["events_per_sec"]
+                            / max(integ_off["events_per_sec"], 1e-9))
+
     base = max(disabled["events_per_sec"], 1e-9)
     metrics_frac = 1.0 - metrics_only["events_per_sec"] / base
     traced_frac = 1.0 - traced["events_per_sec"] / base
@@ -780,6 +797,24 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
             fleet_frac <= 0.02 if (os.cpu_count() or 1) > 2
             else (1.0 - fleet["events_per_sec"]
                   / max(audited["events_per_sec"], 1e-9)) <= 0.10),
+        # The integrity plane's own column: checkpointing at rate with
+        # payload digests on vs off, and its host-scaled guardrail
+        # (<= 2% on > 2-core hosts; <= 10% on a <= 2-core host, where
+        # the digest shares the hot loop's two cores with the writer
+        # thread and between-run drift dominates small deltas —
+        # integrity_gate records which form applied).
+        "integrity_off_events_per_sec": round(
+            integ_off["events_per_sec"], 1),
+        "integrity_events_per_sec": round(
+            integ_on["events_per_sec"], 1),
+        "integrity_overhead_frac": round(integrity_frac, 4),
+        "integrity_gate": ("<=2% vs integrity-off"
+                           if (os.cpu_count() or 1) > 2
+                           else "<=10% vs integrity-off "
+                           "(<=2-core host)"),
+        "integrity_guardrail_pass": (
+            integrity_frac <= (0.02 if (os.cpu_count() or 1) > 2
+                               else 0.10)),
         # The disabled fault plane's own column (--chaos off: injector
         # installed, probabilities zero) and its <= 1% guardrail.
         "chaos_off_events_per_sec": round(
@@ -795,7 +830,9 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
         "converged": (disabled["converged"] and metrics_only["converged"]
                       and traced["converged"] and audited["converged"]
                       and fleet["converged"]
-                      and chaos_off["converged"]),
+                      and chaos_off["converged"]
+                      and integ_off["converged"]
+                      and integ_on["converged"]),
         "wire": disabled["wire"],
         "device": disabled["device"],
     }
@@ -2343,6 +2380,10 @@ def main() -> None:
                     "fleet_guardrail_pass",
                     "chaos_off_overhead_frac",
                     "chaos_guardrail_pass",
+                    "integrity_off_events_per_sec",
+                    "integrity_events_per_sec",
+                    "integrity_overhead_frac", "integrity_gate",
+                    "integrity_guardrail_pass",
                     "disabled_rates", "enabled_rates",
                     "traced_rates", "audited_rates", "fleet_rates",
                     "chaos_off_rates",

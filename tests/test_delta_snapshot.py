@@ -487,9 +487,10 @@ def test_reader_retries_vanished_delta(tmp_path, monkeypatch):
 
 def test_reader_fails_loudly_on_corrupt_chain(tmp_path):
     """A PERMANENTLY missing manifest-named delta (REAL corruption, not
-    the transient compaction race) must surface as the reader's
-    retry-exhaustion RuntimeError at construction — not a silent None
-    epoch."""
+    the transient compaction race) must surface as a classified
+    RuntimeError at construction — a reader with no prior epoch has
+    nothing safe to serve (a reader WITH one keeps serving it; see
+    tests/test_integrity.py)."""
     from attendance_tpu.serve.chain import ChainEpochSource
 
     roster, frames = _mkframes(seed=77)
@@ -506,5 +507,5 @@ def test_reader_fails_loudly_on_corrupt_chain(tmp_path):
     chain = json.loads((snap / CHAIN_MANIFEST).read_text())
     assert chain["deltas"]
     (snap / chain["deltas"][0]).unlink()  # permanent corruption
-    with pytest.raises(RuntimeError, match="kept moving"):
+    with pytest.raises(RuntimeError, match="corrupt"):
         ChainEpochSource(str(snap))

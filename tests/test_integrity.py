@@ -1,0 +1,807 @@
+"""Storage-rot integrity plane: checksummed durability, classified
+restore, scrub, peer-assisted chain repair, and the disk/partition
+chaos fault sites.
+
+Every injected corruption class — flipped payload byte, truncated npz,
+torn manifest JSON, stale digest after a partial rewrite, corrupt spill
+record mid-drain — is exercised against restore AND scrub for both the
+fused chain and the generic sketch-store chain; the property test
+proves scrub detects 100% of deterministic ``disk_corrupt`` injections
+on the CI seeds (101/202/303); the wire half covers the checksummed
+framing variant (gossip + fleet pushes, legacy tolerance, loud
+rejection); and the repair ladder runs end to end: quarantine ->
+truncate -> aggregator re-assert -> state equality with the
+pre-corruption chain.
+"""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from attendance_tpu import chaos, obs
+from attendance_tpu.config import Config
+from attendance_tpu.pipeline.fast_path import (
+    CHAIN_MANIFEST, SKETCH_SNAPSHOT, FusedPipeline, read_chain_state)
+from attendance_tpu.pipeline.loadgen import generate_frames
+from attendance_tpu.transport.memory_broker import MemoryBroker, MemoryClient
+from attendance_tpu.utils import integrity
+from attendance_tpu.utils.integrity import (
+    ChainIntegrityError, IntegrityError, bytes_digest, file_digest,
+    scrub_paths, unwrap_record, wrap_record)
+
+NUM_EVENTS, BATCH = 16_384, 2_048
+
+
+@pytest.fixture(autouse=True)
+def _reset_globals():
+    chaos.disable()
+    obs.disable()
+    yield
+    chaos.disable()
+    obs.disable()
+
+
+def _mkframes(seed=61):
+    return generate_frames(NUM_EVENTS, BATCH, roster_size=6_000,
+                           num_lectures=6, invalid_fraction=0.15,
+                           seed=seed)
+
+
+def _mkcfg(snap_dir="", every=2, **kw):
+    return Config(bloom_filter_capacity=20_000,
+                  transport_backend="memory",
+                  snapshot_dir=snap_dir,
+                  snapshot_every_batches=every if snap_dir else 0, **kw)
+
+
+def _run_chain(tmp_path, seed=61, extra_rounds=1, **cfg_kw):
+    """Build a fused chain with a base + at least one delta; returns
+    (snap_dir, config, reference state dict)."""
+    roster, frames = _mkframes(seed)
+    frames = list(frames)
+    snap = tmp_path / "snaps"
+    config = _mkcfg(str(snap), **cfg_kw)
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    pipe.snapshot()  # full base
+    for _ in range(extra_rounds):
+        for f in frames[:2]:
+            producer.send(f)
+        pipe.run(max_events=2 * BATCH, idle_timeout_s=0.5)
+    expect = {day: pipe.count(day) for day in pipe.lecture_days()}
+    events = pipe._events_total
+    pipe.cleanup()
+    chain = json.loads((snap / CHAIN_MANIFEST).read_text())
+    assert chain["deltas"], "need at least one delta in the chain"
+    assert chain.get("base_digest") and chain.get("digests")
+    return snap, config, {"counts": expect, "events": events,
+                          "chain": chain}
+
+
+def _flip_mid_byte(path):
+    raw = bytearray(Path(path).read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    Path(path).write_bytes(bytes(raw))
+
+
+# ---------------------------------------------------------------------------
+# Digest / record primitives
+# ---------------------------------------------------------------------------
+
+def test_digest_helpers_agree(tmp_path):
+    data = b"storage rot is silent until it is not" * 100
+    p = tmp_path / "blob"
+    p.write_bytes(data)
+    assert file_digest(p) == bytes_digest(data)
+    assert file_digest(p, chunk_size=7) == bytes_digest(data)
+
+
+def test_record_wrap_roundtrip_and_rot():
+    blob = b"spill batch payload" * 50
+    assert unwrap_record(wrap_record(blob)) == (blob, True)
+    # Legacy record (no header): passes through unverified.
+    assert unwrap_record(blob) == (blob, False)
+    wrapped = bytearray(wrap_record(blob))
+    wrapped[len(wrapped) // 2] ^= 0xFF
+    with pytest.raises(IntegrityError):
+        unwrap_record(bytes(wrapped))
+
+
+def test_checksummed_frame_variant():
+    from attendance_tpu.transport.framing import (
+        FrameChecksumError, dec_checksummed, enc_checksummed)
+
+    body = b"\x01\x00merge frame bytes" * 20
+    assert dec_checksummed(enc_checksummed(body)) == (body, True)
+    # Legacy frame: unwrapped passthrough, verified=False.
+    assert dec_checksummed(body) == (body, False)
+    rotten = bytearray(enc_checksummed(body))
+    rotten[-3] ^= 0xFF
+    with pytest.raises(FrameChecksumError):
+        dec_checksummed(bytes(rotten))
+
+
+# ---------------------------------------------------------------------------
+# Fused chain: every corruption class, restore + scrub
+# ---------------------------------------------------------------------------
+
+def test_flipped_delta_byte_classified_and_repaired_locally(tmp_path):
+    snap, config, ref = _run_chain(tmp_path)
+    victim = snap / ref["chain"]["deltas"][-1]
+    _flip_mid_byte(victim)
+
+    with pytest.raises(ChainIntegrityError) as exc_info:
+        read_chain_state(snap)
+    assert exc_info.value.kind == "digest_mismatch"
+    assert exc_info.value.path.name == victim.name
+
+    rows, ok = scrub_paths([snap])
+    assert not ok
+    corrupt = [r for r in rows if r.corrupt]
+    assert [Path(r.path).name for r in corrupt] == [victim.name]
+    assert corrupt[0].kind == "digest_mismatch"
+
+    # Restore repairs locally: quarantine + truncate, never a crash.
+    pipe2 = FusedPipeline(config, client=MemoryClient(MemoryBroker()),
+                          num_banks=8)
+    try:
+        assert (snap / "integrity-quarantine" / victim.name).exists()
+        assert not victim.exists()
+        man = json.loads((snap / CHAIN_MANIFEST).read_text())
+        assert victim.name not in man["deltas"]
+        # Step 3 of the ladder ran eagerly: a fresh full base
+        # superseded the truncated chain and verifies end to end.
+        assert man["deltas"] == []
+        assert not pipe2._base_stale and pipe2._writer_base_ok
+        read_chain_state(snap)  # verifies digests, must not raise
+    finally:
+        pipe2.cleanup()
+    rows, ok = scrub_paths([snap])
+    assert ok, [r.as_list() for r in rows if r.corrupt]
+
+
+def test_truncated_delta_npz_detected(tmp_path):
+    snap, config, ref = _run_chain(tmp_path)
+    victim = snap / ref["chain"]["deltas"][-1]
+    raw = victim.read_bytes()
+    victim.write_bytes(raw[:len(raw) // 2])
+    with pytest.raises(ChainIntegrityError) as exc_info:
+        read_chain_state(snap)
+    assert exc_info.value.kind == "digest_mismatch"
+    rows, ok = scrub_paths([snap])
+    assert not ok
+
+
+def test_truncated_delta_without_digests_still_classified(tmp_path):
+    """Legacy chain (pre-integrity manifest): truncation cannot be
+    caught by a digest, but the classified structural failure must
+    surface — never an opaque numpy error."""
+    snap, config, ref = _run_chain(tmp_path)
+    man = json.loads((snap / CHAIN_MANIFEST).read_text())
+    man.pop("digests", None)
+    man.pop("base_digest", None)
+    (snap / CHAIN_MANIFEST).write_text(json.dumps(man))
+    victim = snap / ref["chain"]["deltas"][-1]
+    raw = victim.read_bytes()
+    victim.write_bytes(raw[:len(raw) // 2])
+    with pytest.raises(ChainIntegrityError) as exc_info:
+        read_chain_state(snap)
+    assert exc_info.value.kind == "unreadable"
+
+
+def test_torn_manifest_json_detected_and_repaired(tmp_path):
+    snap, config, ref = _run_chain(tmp_path)
+    manifest = snap / CHAIN_MANIFEST
+    raw = manifest.read_bytes()
+    manifest.write_bytes(raw[:len(raw) // 2])  # torn JSON
+    with pytest.raises(ChainIntegrityError) as exc_info:
+        read_chain_state(snap)
+    assert exc_info.value.kind == "torn_manifest"
+    rows, ok = scrub_paths([snap])
+    assert not ok
+    assert any(r.kind == "torn_manifest" for r in rows if r.corrupt)
+
+    # Repair: manifest quarantined, base-only restore, fresh manifest.
+    pipe2 = FusedPipeline(config, client=MemoryClient(MemoryBroker()),
+                          num_banks=8)
+    try:
+        assert pipe2._events_restored > 0  # the base still restored
+        assert json.loads(manifest.read_text())["deltas"] == []
+    finally:
+        pipe2.cleanup()
+    rows, ok = scrub_paths([snap])
+    assert ok
+
+
+def test_stale_digest_after_partial_rewrite(tmp_path):
+    """A partial in-place rewrite (rot that changes bytes but leaves a
+    parseable-SIZE file) must trip the digest even when the content is
+    a perfectly well-formed npz — the manifest recorded different
+    bytes."""
+    snap, config, ref = _run_chain(tmp_path)
+    victim = snap / ref["chain"]["deltas"][-1]
+    # Rewrite the delta with a VALID npz of different content: only
+    # the digest can notice (np.load would succeed happily).
+    with open(victim, "wb") as f:
+        np.savez(f, bank_idx=np.zeros(1, np.int32),
+                 regs_rows=np.zeros((1, 1 << 14), np.uint8),
+                 counts=np.zeros((2, 2), np.uint32),
+                 manifest=np.frombuffer(json.dumps(
+                     {"bank_of": {}, "events": 0,
+                      "num_banks": 8}).encode(), np.uint8))
+    with pytest.raises(ChainIntegrityError) as exc_info:
+        read_chain_state(snap)
+    assert exc_info.value.kind == "digest_mismatch"
+    rows, ok = scrub_paths([snap])
+    assert not ok
+
+
+def test_missing_named_delta_classified(tmp_path):
+    snap, config, ref = _run_chain(tmp_path)
+    (snap / ref["chain"]["deltas"][-1]).unlink()
+    with pytest.raises(ChainIntegrityError) as exc_info:
+        read_chain_state(snap)
+    assert exc_info.value.kind == "missing"
+    rows, ok = scrub_paths([snap])
+    assert not ok
+    assert any(r.kind == "missing" for r in rows if r.corrupt)
+
+
+def test_corrupt_base_without_peer_starts_empty_loudly(tmp_path):
+    snap, config, ref = _run_chain(tmp_path)
+    _flip_mid_byte(snap / SKETCH_SNAPSHOT)
+    pipe2 = FusedPipeline(config, client=MemoryClient(MemoryBroker()),
+                          num_banks=8)
+    try:
+        # No peer to re-assert from: starts empty (restore returned
+        # False), with the corrupt base preserved for triage.
+        assert pipe2._events_restored == 0
+        assert (snap / "integrity-quarantine"
+                / SKETCH_SNAPSHOT).exists()
+    finally:
+        pipe2.cleanup()
+
+
+def test_stale_base_digest_crash_window_tolerated(tmp_path):
+    """The one LEGIT digest mismatch: a crash between the base's
+    in-place replace and the chain-manifest reset leaves CHAIN.json
+    recording the old base's digest. A structurally clean base must
+    restore (chain_seq fences the stale deltas) — treating this as rot
+    would turn the documented crash window into data loss."""
+    snap, config, ref = _run_chain(tmp_path)
+    man = json.loads((snap / CHAIN_MANIFEST).read_text())
+    man["base_digest"] = "0" * 64  # stale: describes the "old" base
+    (snap / CHAIN_MANIFEST).write_text(json.dumps(man))
+    state = read_chain_state(snap)  # must NOT raise
+    assert state["events"] == ref["events"]
+    rows, ok = scrub_paths([snap])
+    assert ok
+    assert any(r.status == "stale-digest" for r in rows)
+
+
+def test_rotted_event_segment_quarantined_not_crash(tmp_path):
+    """Event-store segment files carry no digests, but their rot must
+    still be classified: scrub detects it structurally (zip CRCs) and
+    restore quarantines the offender and loads the survivors — never
+    an opaque numpy crash, never silent."""
+    snap, config, ref = _run_chain(tmp_path)
+    segs = sorted((snap / "fused_events_segs").glob("segment-*.npz"))
+    assert segs, "delta-mode run should write event segments"
+    _flip_mid_byte(segs[0])
+    rows, ok = scrub_paths([snap])
+    assert not ok
+    assert any(r.artifact == "events-file" for r in rows if r.corrupt)
+    pipe2 = FusedPipeline(config, client=MemoryClient(MemoryBroker()),
+                          num_banks=8)
+    try:
+        # Sketch state restored untouched; the rotted segment went to
+        # quarantine and the surviving rows loaded.
+        assert pipe2._events_restored == ref["events"]
+        assert {d: pipe2.count(d) for d in pipe2.lecture_days()} \
+            == ref["counts"]
+        qdir = snap / "fused_events_segs" / "integrity-quarantine"
+        assert any(qdir.glob("segment-*.npz"))
+    finally:
+        pipe2.cleanup()
+    rows, ok = scrub_paths([snap])
+    assert ok
+
+
+def test_rot_in_stale_delta_never_triggers_repair(tmp_path):
+    """The crash window leaves CHAIN.json naming deltas OLDER than the
+    replaced base (chain_seq fences them out of restore). Rot in one
+    of those never-applied files must not trigger a repair — the
+    staleness skip runs before verification, so the good state
+    restores untouched."""
+    roster, frames = _mkframes(seed=71)
+    frames = list(frames)
+    snap = tmp_path / "snaps"
+    config = _mkcfg(str(snap))
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    man = json.loads((snap / CHAIN_MANIFEST).read_text())
+    assert man["deltas"]
+    stale = man["deltas"][0]
+    expect_events = pipe._events_total
+
+    def crash(*a, **kw):
+        raise OSError("simulated crash before chain-manifest reset")
+
+    pipe._write_chain_manifest = crash
+    with pytest.raises(OSError):
+        pipe.snapshot()  # base replaced, manifest reset "crashed"
+    pipe.cleanup()
+    _flip_mid_byte(snap / stale)  # rot in the fenced-out stale delta
+    state = read_chain_state(snap)  # must not raise, must not repair
+    assert state["events"] == expect_events
+    assert state["applied"] == []  # stale deltas skipped, not applied
+
+
+def test_scrub_flags_orphan_deltas_as_tolerated(tmp_path):
+    snap, config, ref = _run_chain(tmp_path)
+    with open(snap / "delta-9999.npz", "wb") as f:
+        np.savez(f, junk=np.zeros(4))
+    rows, ok = scrub_paths([snap])
+    assert ok  # orphans are ignored by restore, tolerated by scrub
+    assert any(r.status == "orphan" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# Generic sketch-store chain
+# ---------------------------------------------------------------------------
+
+def _store_chain(tmp_path):
+    from attendance_tpu.sketch.memory_store import MemorySketchStore
+    from attendance_tpu.utils.snapshot import snapshot_sketch_store_chain
+
+    d = tmp_path / "store-chain"
+    store = MemorySketchStore(Config())
+    store.bf_reserve("bf:students", 0.01, 1000)
+    store.bf_add_many("bf:students", np.arange(100, dtype=np.uint32))
+    store.pfadd_many("hll:unique:1", np.arange(50, dtype=np.uint32))
+    snapshot_sketch_store_chain(store, d)  # base
+    store.bf_add_many("bf:students",
+                      np.arange(100, 200, dtype=np.uint32))
+    store.pfadd_many("hll:unique:1",
+                     np.arange(50, 80, dtype=np.uint32))
+    snapshot_sketch_store_chain(store, d)  # delta
+    return d, store
+
+
+@pytest.mark.parametrize("corruption", ["flip", "truncate", "torn_manifest",
+                                        "missing", "stale_rewrite"])
+def test_store_chain_corruption_classes(tmp_path, corruption):
+    from attendance_tpu.sketch.memory_store import MemorySketchStore
+    from attendance_tpu.utils.snapshot import restore_sketch_store
+
+    d, store = _store_chain(tmp_path)
+    man = json.loads((d / "MANIFEST.json").read_text())
+    victim = d / man["deltas"][0]
+    if corruption == "flip":
+        _flip_mid_byte(victim)
+        want_kind = "digest_mismatch"
+    elif corruption == "truncate":
+        raw = victim.read_bytes()
+        victim.write_bytes(raw[:len(raw) // 2])
+        want_kind = "digest_mismatch"
+    elif corruption == "torn_manifest":
+        raw = (d / "MANIFEST.json").read_bytes()
+        (d / "MANIFEST.json").write_bytes(raw[:len(raw) // 2])
+        want_kind = "torn_manifest"
+    elif corruption == "missing":
+        victim.unlink()
+        want_kind = "missing"
+    else:  # stale_rewrite: valid npz, different bytes
+        with open(victim, "wb") as f:
+            np.savez(f, __manifest__=np.frombuffer(json.dumps(
+                {"blooms": {}, "hll": {"kind": "rows", "keys": [],
+                                       "precision": 14}}).encode(),
+                np.uint8))
+        want_kind = "digest_mismatch"
+    restored = MemorySketchStore(Config())
+    with pytest.raises(ChainIntegrityError) as exc_info:
+        restore_sketch_store(restored, d)
+    assert exc_info.value.kind == want_kind
+    rows, ok = scrub_paths([d])
+    assert not ok
+    assert any(r.kind == want_kind for r in rows if r.corrupt)
+
+
+def test_store_chain_clean_roundtrip_still_works(tmp_path):
+    from attendance_tpu.sketch.memory_store import MemorySketchStore
+    from attendance_tpu.utils.snapshot import restore_sketch_store
+
+    d, store = _store_chain(tmp_path)
+    restored = MemorySketchStore(Config())
+    restore_sketch_store(restored, d)
+    assert restored.pfcount("hll:unique:1") == \
+        store.pfcount("hll:unique:1")
+    assert bool(restored.bf_exists_many("bf:students",
+                                        np.asarray([150]))[0])
+    rows, ok = scrub_paths([d])
+    assert ok
+
+
+# ---------------------------------------------------------------------------
+# Spill buffer: per-record checksums, corrupt record mid-drain
+# ---------------------------------------------------------------------------
+
+class _FlakySink:
+    def __init__(self):
+        self.fail = False
+        self.rows = []
+
+    def insert_batch(self, rows):
+        if self.fail:
+            raise RuntimeError("sink down")
+        self.rows.extend(rows)
+
+    def insert_columns(self, cols):
+        self.insert_batch([tuple(v) for v in zip(*cols.values())])
+
+    def close(self):
+        pass
+
+
+def test_corrupt_spill_record_dropped_mid_drain(tmp_path):
+    from attendance_tpu.storage.resilient import (
+        CircuitBreaker, ResilientEventStore)
+
+    obs.enable(Config(metrics_port=-1))
+    sink = _FlakySink()
+    store = ResilientEventStore(
+        sink, tmp_path / "spill", sink="events",
+        breaker=CircuitBreaker(failure_threshold=1, cooldown_s=0.01))
+    sink.fail = True
+    for i in range(3):
+        store.insert_batch([(i, "a")])
+    files = sorted((tmp_path / "spill").glob("spill-*.pkl"))
+    assert len(files) == 3
+    # Every spill record carries the checksum header.
+    for f in files:
+        _, verified = unwrap_record(f.read_bytes())
+        assert verified
+    # Rot the MIDDLE record, then heal the sink and drain.
+    _flip_mid_byte(files[1])
+    rows, ok = scrub_paths([tmp_path / "spill"])
+    assert not ok and sum(r.corrupt for r in rows) == 1
+    sink.fail = False
+    time.sleep(0.02)
+    assert store.flush_spill(budget_s=5.0)
+    # Records 0 and 2 drained in order; the rotten one was dropped
+    # loudly (its frames would redeliver), never unpickled into rows.
+    assert sink.rows == [(0, "a"), (2, "a")]
+    reg = obs.get().registry
+    total = 0.0
+    for name, _kind, _help, members in reg.collect():
+        if name == "attendance_spill_corrupt_records_total":
+            total += sum(m.value for m in members)
+    assert total == 1.0
+    store.close()
+
+
+def test_legacy_spill_record_still_drains(tmp_path):
+    """Pre-integrity spill files (bare pickle, no header) must keep
+    draining — restart adoption across the upgrade boundary."""
+    import pickle
+
+    from attendance_tpu.storage.resilient import ResilientEventStore
+
+    spill = tmp_path / "spill"
+    spill.mkdir()
+    (spill / "spill-000001.pkl").write_bytes(pickle.dumps(
+        {"kind": "rows", "data": [(7, "legacy")]}))
+    sink = _FlakySink()
+    store = ResilientEventStore(sink, spill, sink="events")
+    assert store.flush_spill(budget_s=5.0)
+    assert sink.rows == [(7, "legacy")]
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# Property test: scrub detects 100% of deterministic disk_corrupt
+# injections (the CI seeds)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_scrub_detects_all_disk_corrupt_injections(tmp_path, seed):
+    from attendance_tpu.sketch.memory_store import MemorySketchStore
+    from attendance_tpu.utils.snapshot import snapshot_sketch_store_chain
+
+    inj = chaos.ChaosInjector(
+        chaos.ChaosSpec.parse("disk_corrupt=0.5,torn_write=0.25"),
+        seed=seed)
+    chaos.INJECTOR = inj
+    d = tmp_path / f"chain-{seed}"
+    store = MemorySketchStore(Config())
+    store.bf_reserve("bf", 0.01, 500)
+    rng = np.random.default_rng(seed)
+    for i in range(8):
+        store.bf_add_many("bf", rng.integers(0, 10_000, 32,
+                                             dtype=np.uint32))
+        store.pfadd_many(f"hll:{i % 3}",
+                         rng.integers(0, 10_000, 32, dtype=np.uint32))
+        snapshot_sketch_store_chain(store, d)
+    chaos.disable()
+    assert inj.disk_faults, "seeded spec never fired — grow the run"
+    # Every injected disk fault whose rot STILL sits on disk (not
+    # healed by a later manifest rewrite, not GC'd by compaction)
+    # must be detected by scrub — 100%, no exceptions.
+    surviving = integrity.surviving_disk_faults(inj.disk_faults)
+    assert surviving, f"seed {seed}: every fault healed — grow the run"
+    rows, ok = scrub_paths([d])
+    # Detected as CORRUPT, or classified ORPHAN (a rotted file whose
+    # manifest write then failed was never published — restore never
+    # trusts it, so orphan-rot is accounted for, not missed).
+    flagged = {r.path for r in rows
+               if r.corrupt or r.status == "orphan"}
+    missed = surviving - flagged
+    assert not missed, f"scrub missed injected corruption: {missed}"
+    corrupt = {r.path for r in rows if r.corrupt}
+    if surviving & corrupt:
+        assert not ok
+
+
+# ---------------------------------------------------------------------------
+# ENOSPC: distinct handling at the snapshot writer
+# ---------------------------------------------------------------------------
+
+def test_enospc_skips_backoff_ladder_and_counts(tmp_path):
+    t = obs.enable(Config(metrics_port=-1))
+    chaos.INJECTOR = chaos.ChaosInjector(
+        chaos.ChaosSpec.parse("enospc=1.0"), seed=1)
+    roster, frames = _mkframes()
+    frames = list(frames)
+    snap = tmp_path / "snaps"
+    config = _mkcfg(str(snap), chaos="enospc=1.0")
+    client = MemoryClient(MemoryBroker())
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    try:
+        pipe.preload(roster)
+        producer = client.create_producer(config.pulsar_topic)
+        for f in frames[:2]:
+            producer.send(f)
+        pipe.run(max_events=2 * BATCH, idle_timeout_s=0.5)
+        pipe._checkpoint_async(force=True)
+        pipe._flush_snapshots()
+        # One ENOSPC failure jumps STRAIGHT to the capped cadence —
+        # no 50ms->5s ladder of full-base attempts into a full disk.
+        assert pipe._snap_fail_streak >= 8
+        assert pipe._writer_backoff_s() == 5.0
+        total = 0.0
+        for name, _k, _h, members in t.registry.collect():
+            if name == "attendance_snapshot_disk_full_total":
+                total += sum(m.value for m in members)
+        assert total >= 1.0
+    finally:
+        chaos.disable()  # writer must not fail CLEANUP's final writes
+        pipe.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Partition blackhole windows
+# ---------------------------------------------------------------------------
+
+def test_partition_blackhole_window_deterministic():
+    inj = chaos.ChaosInjector(
+        chaos.ChaosSpec.parse("partition=100ms:1.0"), seed=7)
+    assert inj.blackhole("fed.gossip")          # window opens
+    assert inj.blackhole("fed.gossip")          # still inside
+    assert inj.injected_total("partition") == 1  # one window, one count
+    time.sleep(0.12)
+    assert inj.blackhole("fed.gossip")          # p=1.0: reopens
+    assert inj.injected_total("partition") == 2
+    quiet = chaos.ChaosInjector(chaos.ChaosSpec.parse("drop=0.5"), 7)
+    assert not quiet.blackhole("fed.gossip")    # partition not armed
+
+
+def test_partition_blackholes_gossip_but_converges_on_full_frame():
+    from attendance_tpu.federation.gossip import Aggregator, FenceGossip
+
+    broker = MemoryBroker()
+    agg = Aggregator(client=MemoryClient(broker), topic="g",
+                     num_shards=1, dead_after_s=60, precision=14)
+    cfg = Config(fed_worker="w0", fed_shard=0, fed_shards=1,
+                 fed_gossip_topic="g", fed_heartbeat_s=0)
+    fg = FenceGossip(cfg, client=MemoryClient(broker), m_bits=512, k=3)
+    chaos.INJECTOR = chaos.ChaosInjector(
+        chaos.ChaosSpec.parse("partition=10s:1.0"), seed=3)
+    regs = np.ones((1, 1 << 14), np.uint8)
+    counts = np.zeros((2, 2), np.uint32)
+    # Blackholed: publisher believes success, nothing arrives.
+    assert fg.publish_delta(np.asarray([0], np.int32), regs, counts,
+                            {5: 0}, 10, 1)
+    assert agg.poll(timeout_ms=200) == 0
+    # Heal, then the final full frame re-asserts everything.
+    chaos.disable()
+    bloom = np.arange(16, dtype=np.uint32)
+    assert fg.publish_full(bloom, regs, counts, {5: 0}, 10)
+    assert agg.poll(timeout_ms=500) == 1
+    assert 5 in agg.view.bank_of
+    agg.stop()
+    fg.close()
+
+
+def test_partition_consume_side_is_silence_not_loss():
+    from attendance_tpu.transport.memory_broker import ReceiveTimeout
+
+    broker = MemoryBroker()
+    client = MemoryClient(broker)
+    inj = chaos.ChaosInjector(
+        chaos.ChaosSpec.parse("partition=150ms:1.0"), seed=5)
+    wrapped = chaos.ChaosClient(client, inj)
+    producer = wrapped.create_producer("t")
+    consumer = wrapped.subscribe("t", "s")
+    producer.send(b"payload")
+    with pytest.raises(ReceiveTimeout):
+        consumer.receive(timeout_millis=50)  # inside the window
+    # Heal the partition (p=1.0 would reopen a fresh window on every
+    # roll): the blackholed message was never lost, only unseen.
+    consumer._inj = chaos.ChaosInjector(chaos.ChaosSpec.parse("off"), 5)
+    msg = consumer.receive(timeout_millis=1000)
+    assert bytes(msg.data()) == b"payload"  # broker retained it
+
+
+# ---------------------------------------------------------------------------
+# Serve-plane chain reader survives corruption
+# ---------------------------------------------------------------------------
+
+def test_chain_reader_keeps_serving_on_rot(tmp_path):
+    from attendance_tpu.serve.chain import ChainEpochSource
+
+    t = obs.enable(Config(metrics_port=-1))
+    snap, config, ref = _run_chain(tmp_path)
+    src = ChainEpochSource(str(snap), refresh_s=0.05)
+    good = src.pin()
+    assert good is not None and good.events == ref["events"]
+
+    # Rot a delta AND touch the manifest so the fingerprint changes.
+    victim = snap / ref["chain"]["deltas"][-1]
+    _flip_mid_byte(victim)
+    man_raw = (snap / CHAIN_MANIFEST).read_text()
+    (snap / CHAIN_MANIFEST).write_text(man_raw + " ")
+    assert src.reload(force=True) is False  # no new epoch, no raise
+    still = src.pin()
+    assert still is good  # the last good epoch keeps serving
+    assert (snap / "integrity-quarantine" / victim.name).exists()
+    total = 0.0
+    for name, _k, _h, members in t.registry.collect():
+        if name == "attendance_chain_corrupt_files_total":
+            total += sum(m.value for m in members)
+    assert total >= 1.0
+    src.stop()
+
+
+# ---------------------------------------------------------------------------
+# Peer-assisted repair ladder, end to end
+# ---------------------------------------------------------------------------
+
+def test_peer_reassert_repairs_corrupt_delta_end_to_end(tmp_path):
+    """The full ladder: a federated worker's chain rots, a fresh
+    pipeline quarantines the delta, asks the aggregator (whose
+    retained per-worker view folded that delta's banks when it was
+    gossiped) to re-assert, and restores state EQUAL to the
+    pre-corruption chain."""
+    from attendance_tpu.federation.gossip import Aggregator
+
+    broker = MemoryBroker()
+    agg = Aggregator(client=MemoryClient(broker),
+                     topic="attendance-fed-gossip", num_shards=1,
+                     dead_after_s=600, precision=14)
+
+    roster, frames = _mkframes(seed=91)
+    frames = list(frames)
+    snap = tmp_path / "snaps"
+    fed_kw = dict(fed_worker="w0", fed_shard=0, fed_shards=1,
+                  fed_heartbeat_s=0)
+    config = _mkcfg(str(snap), **fed_kw)
+    client = MemoryClient(broker)
+    pipe = FusedPipeline(config, client=client, num_banks=8)
+    pipe.preload(roster)
+    producer = client.create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=NUM_EVENTS, idle_timeout_s=0.5)
+    pipe.snapshot()
+    for f in frames[:2]:
+        producer.send(f)
+    pipe.run(max_events=2 * BATCH, idle_timeout_s=0.5)
+    expect = {day: pipe.count(day) for day in pipe.lecture_days()}
+    expect_events = pipe._events_total
+    expect_bloom = np.asarray(pipe.state.bloom_bits).copy()
+    pipe.cleanup()
+
+    # The aggregator folds everything the worker gossiped (fences +
+    # the cleanup flush), retaining the worker's own contribution.
+    while agg.poll(timeout_ms=300) > 0:
+        pass
+    assert "w0" in agg.view.worker_state
+
+    # Rot the newest delta, then restore a fresh federated pipeline.
+    chain = json.loads((snap / CHAIN_MANIFEST).read_text())
+    assert chain["deltas"]
+    _flip_mid_byte(snap / chain["deltas"][-1])
+
+    # Serve repair requests from a background thread (the worker's
+    # restore blocks on the re-assert round-trip).
+    stop = threading.Event()
+
+    def _serve():
+        while not stop.is_set():
+            agg.poll(timeout_ms=100)
+
+    server = threading.Thread(target=_serve, daemon=True)
+    server.start()
+    try:
+        pipe2 = FusedPipeline(_mkcfg(str(snap), **fed_kw),
+                              client=MemoryClient(broker), num_banks=8)
+    finally:
+        stop.set()
+        server.join(timeout=2)
+    try:
+        got = {day: pipe2.count(day) for day in pipe2.lecture_days()}
+        assert got == expect, "re-assert did not recover the lost banks"
+        assert pipe2._events_total == expect_events
+        assert (np.asarray(pipe2.state.bloom_bits)
+                == expect_bloom).all()
+        assert (snap / "integrity-quarantine"
+                / chain["deltas"][-1]).exists()
+    finally:
+        pipe2.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Scrub CLI verb + doctor --scrub
+# ---------------------------------------------------------------------------
+
+def test_scrub_cli_verb_and_doctor_scrub(tmp_path, capsys):
+    from attendance_tpu import cli
+
+    snap, config, ref = _run_chain(tmp_path)
+    cli.main(["scrub", str(snap)])  # clean chain: exit 0 (no raise)
+    out = capsys.readouterr().out
+    assert "PASS" in out
+
+    _flip_mid_byte(snap / ref["chain"]["deltas"][-1])
+    with pytest.raises(SystemExit) as exc_info:
+        cli.main(["scrub", str(snap)])
+    assert exc_info.value.code == 1
+    out = capsys.readouterr().out
+    assert "digest_mismatch" in out
+
+    with pytest.raises(SystemExit) as exc_info:
+        cli.main(["doctor", "--scrub", str(snap)])
+    assert exc_info.value.code == 1
+
+    with pytest.raises(SystemExit) as exc_info:
+        cli.main(["scrub", str(tmp_path / "no-such-dir")])
+    assert exc_info.value.code == 2
+
+
+def test_quarantine_sidecar_uses_shared_digest(tmp_path):
+    from attendance_tpu.transport.quarantine import Quarantine, list_entries
+
+    q = Quarantine(tmp_path / "q")
+    q.put(b"poison frame", topic="t", reason="decode")
+    (entry,) = list_entries(tmp_path / "q")
+    assert entry["sha256"] == bytes_digest(b"poison frame")
+    rows, ok = scrub_paths([tmp_path / "q"])
+    assert ok
+    # Rot the frame: the sidecar digest catches it.
+    _flip_mid_byte(Path(entry["frame"]))
+    rows, ok = scrub_paths([tmp_path / "q"])
+    assert not ok

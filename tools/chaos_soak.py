@@ -53,6 +53,18 @@ sys.path.insert(0, str(REPO))
 DEFAULT_SPEC = ("drop=0.03,delay=2ms:0.03,dup=0.02,conn_reset=0.03,"
                 "persist_fail=0.15,writer_stall=30ms:0.1,"
                 "snap_fail=0.1,corrupt=0.02")
+# The storage-rot + partition seed (``--spec rot``): post-fsync bit
+# flips and torn writes in the snapshot chain, injected ENOSPC at the
+# writer seam, and consume-side partition blackhole windows — on top
+# of a thinner transport-fault baseline. persist_fail is OFF here on
+# purpose: rot inside the spill buffer is detectable-but-lossy by
+# contract (covered by tests/test_integrity.py), so mixing it in would
+# turn the oracle-equality gate into a tautology-breaker instead of a
+# corruption-detection proof.
+ROT_SPEC = ("drop=0.02,dup=0.02,conn_reset=0.02,corrupt=0.02,"
+            "snap_fail=0.05,writer_stall=20ms:0.05,"
+            "disk_corrupt=0.08,torn_write=0.04,enospc=0.02,"
+            "partition=600ms:0.05")
 NUM_EVENTS, BATCH = 32_768, 512
 ROSTER, LECTURES = 10_000, 8
 POISON_FRAMES = 2
@@ -186,9 +198,18 @@ def run_soak(seed: int, *, spec: str = DEFAULT_SPEC, workdir,
     done = threading.Event()
     errors = []
 
+    # An ENOSPC hit parks the snapshot writer at the CAPPED 5s backoff
+    # (by design: no ladder of full-base attempts into a full disk) —
+    # during that park the broker's unacked in-flight bound stalls
+    # delivery, so the idle window must outlast the cap. Two
+    # consecutive failed disk attempts (enospc then snap_fail, ~1% of
+    # barrier sequences under this spec) chain two capped backoffs:
+    # cover that too, or the run exits with a healthy backlog queued.
+    idle_s = 15.0 if inj.spec.enospc > 0 else 3.0
+
     def _run():
         try:
-            pipe.run(idle_timeout_s=3.0)
+            pipe.run(idle_timeout_s=idle_s)
         except BaseException as exc:  # noqa: BLE001 — report, don't hang
             errors.append(exc)
         finally:
@@ -272,6 +293,49 @@ def run_soak(seed: int, *, spec: str = DEFAULT_SPEC, workdir,
                   for digs in per_poison),
               "a poison frame never reached the quarantine")
 
+        # Storage-rot gates (the integrity plane, active iff the spec
+        # armed disk faults): every injection whose rot still sits on
+        # disk must be DETECTED by scrub — 100%, no exceptions — and
+        # the run above already proved the rot cost nothing (state ==
+        # oracle: the writer's in-memory mirror, not the rotted files,
+        # is what served the run).
+        if inj.injected_total("disk_corrupt") \
+                or inj.injected_total("torn_write"):
+            from attendance_tpu.utils.integrity import (
+                scrub_paths, surviving_disk_faults)
+            surviving = surviving_disk_faults(inj.disk_faults)
+            rows, _scrub_ok = scrub_paths([work])
+            # "Accounted for" = flagged CORRUPT, or classified as an
+            # ORPHAN: a rotted delta whose manifest write then failed
+            # was never published — restore ignores it and its frames
+            # redeliver, so orphan-rot is harmless by construction
+            # (and must not be reported as a silent miss).
+            flagged = {r.path for r in rows
+                       if r.corrupt or r.status == "orphan"}
+            missed = surviving - flagged
+            check(not missed,
+                  f"scrub missed injected disk rot: {sorted(missed)}")
+            report["disk_faults_injected"] = len(inj.disk_faults)
+            report["disk_rot_surviving"] = len(surviving)
+            report["scrub_accounted"] = len(surviving & flagged)
+        if inj.spec.partition > 0:
+            # Consume-side blackhole windows: the broker retained
+            # everything, so the oracle-equality gate above IS the
+            # convergence proof; here we only assert the fault
+            # actually fired (a partition seed that never partitions
+            # proves nothing).
+            check(inj.injected_total("partition") > 0,
+                  "partition armed but no blackhole window opened")
+            report["partition_windows"] = inj.injected_total(
+                "partition")
+        if inj.injected_total("enospc"):
+            disk_full = _counter_total(
+                registry, "attendance_snapshot_disk_full_total")
+            check(disk_full > 0,
+                  "enospc injected but the disk-full counter never "
+                  "fired (writer mis-classified it)")
+            report["enospc_hits"] = disk_full
+
         # Doctor gate over the run's own artifacts.
         t = obs.get()
         t.finalize_slo("soak-end")
@@ -300,11 +364,15 @@ def main() -> int:
     ap.add_argument("--seed", type=int, action="append", default=None,
                     help="soak seed (repeatable; default 1)")
     ap.add_argument("--spec", default=DEFAULT_SPEC,
-                    help="chaos spec for the fault run")
+                    help="chaos spec for the fault run ('rot' = the "
+                    "storage-rot + partition spec: disk_corrupt/"
+                    "torn_write/enospc/partition with scrub gates)")
     ap.add_argument("--workdir", default="/tmp/chaos_soak")
     ap.add_argument("--max-seconds", type=float, default=90.0,
                     help="per-seed deadline (termination invariant)")
     args = ap.parse_args()
+    if args.spec == "rot":
+        args.spec = ROT_SPEC
     seeds = args.seed or [1]
     rc = 0
     for seed in seeds:

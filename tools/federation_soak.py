@@ -69,7 +69,8 @@ def _worker_log(workdir: Path, shard: int) -> Path:
 def _spawn_worker(addr: str, workdir: Path, shard: int, n_events: int,
                   seed: int, *, takeover: bool = False,
                   ready: str = "", go: str = "",
-                  fleet_push: str = "") -> subprocess.Popen:
+                  fleet_push: str = "",
+                  chaos_spec: str = "") -> subprocess.Popen:
     cmd = [sys.executable, "-m", "attendance_tpu.federation.worker",
            "--worker", f"w{shard}", "--shard", str(shard),
            "--num-shards", str(K), "--broker", addr,
@@ -77,6 +78,8 @@ def _spawn_worker(addr: str, workdir: Path, shard: int, n_events: int,
            "--workdir", str(workdir), "--data-plane", "socket",
            "--num-events", str(n_events), "--seed", str(seed),
            "--snapshot-every", "2", "--idle-timeout-s", "4"]
+    if chaos_spec:
+        cmd += ["--chaos", chaos_spec, "--chaos-seed", str(seed)]
     if fleet_push:
         cmd += ["--fleet-push", fleet_push]
     if takeover:
@@ -110,6 +113,14 @@ def main() -> int:
     ap.add_argument("--merge-lag-ceiling", type=float, default=5.0,
                     help="doctor merge-lag p99 gate (generous: "
                     "shared CI runners)")
+    ap.add_argument("--partition-spec",
+                    default="partition=1200ms:0.04",
+                    help="chaos spec injected into worker w2 "
+                    "(one-way gossip + consume blackhole windows; "
+                    "'' disables)")
+    ap.add_argument("--no-disk-corrupt", action="store_true",
+                    help="skip the deterministic post-kill delta "
+                    "corruption + peer-assisted repair gates")
     args = ap.parse_args()
 
     work = Path(args.workdir)
@@ -161,7 +172,13 @@ def main() -> int:
             workers.append(_spawn_worker(
                 addr, work, s, n_events, args.seed,
                 ready=str(ready), go=str(go),
-                fleet_push=collector.address))
+                fleet_push=collector.address,
+                # w2 runs under injected PARTITION windows (one-way
+                # gossip + consume blackholes): the broker retains
+                # through consume silence, and the assured final
+                # fed_flush re-asserts through gossip loss — gate C's
+                # oracle equality is the convergence proof.
+                chaos_spec=(args.partition_spec if s == 2 else "")))
         deadline = time.time() + 300
         for s in range(K):
             while not (work / f"ready-{s}").exists():
@@ -232,6 +249,24 @@ def main() -> int:
               f"{map_v_dead}, chain recovered "
               f"({stats['recovered_chains']})", flush=True)
 
+        # Storage rot on the dead peer's chain (the integrity plane's
+        # acceptance choreography): flip one byte mid-file in a
+        # manifest-named delta AFTER the aggregator recovered the
+        # chain (its retained per-worker view already holds the
+        # delta's banks — they were gossiped at their fences). The
+        # takeover's restore must classify the rot, quarantine the
+        # file, and repair PEER-ASSISTED via a re-assert request.
+        corrupted_delta = ""
+        if not args.no_disk_corrupt:
+            chain_doc = json.loads(chain.read_text())
+            corrupted_delta = chain_doc["deltas"][-1]
+            victim = work / f"chain-{KILLED}" / corrupted_delta
+            raw = bytearray(victim.read_bytes())
+            raw[len(raw) // 2] ^= 0xFF
+            victim.write_bytes(bytes(raw))
+            print(f"[soak] injected disk_corrupt into {victim.name} "
+                  "(post-fsync bit flip)", flush=True)
+
         # Takeover worker: same id, same chain dir, higher incarnation.
         takeover = _spawn_worker(addr, work, KILLED, n_events,
                                  args.seed, takeover=True,
@@ -278,6 +313,29 @@ def main() -> int:
         print(f"[soak] gate B: takeover re-claimed shard {KILLED} "
               f"(incarnation {w1['incarnation']:.3f} > "
               f"{dead_incarnation:.3f})", flush=True)
+
+        # Gate B2: the rot was detected, quarantined, and repaired —
+        # never crash-looped. The corrupt delta must sit in the chain
+        # dir's integrity-quarantine with its sidecar, the manifest
+        # must have stopped naming it, and the takeover's log must
+        # show the peer-assisted repair.
+        if corrupted_delta:
+            qdir = work / f"chain-{KILLED}" / "integrity-quarantine"
+            if not (qdir / corrupted_delta).exists():
+                return _fail(f"corrupt delta {corrupted_delta} was "
+                             "never quarantined")
+            man_now = json.loads(chain.read_text())
+            if corrupted_delta in man_now.get("deltas", []):
+                return _fail("manifest still names the quarantined "
+                             f"delta: {man_now}")
+            log = _worker_log(work, KILLED).read_text()
+            if "folded peer re-assert" not in log:
+                return _fail("takeover log shows no peer-assisted "
+                             "repair (re-assert never arrived):\n"
+                             + log[-2000:])
+            print(f"[soak] gate B2: {corrupted_delta} quarantined, "
+                  "chain truncated, peer re-assert folded",
+                  flush=True)
 
         # Drain the gossip tail synchronously, then assert.
         agg.pause()
@@ -437,8 +495,21 @@ def main() -> int:
     print(f"[soak] gate F: {len(stitched)}/{len(merges)} fed_merge "
           "spans stitched under worker fence_publish spans",
           flush=True)
-    print("PASS: federation soak (dead-peer takeover, oracle-equal "
-          "merged state, zero false negatives, doctor + fleet gates)",
+
+    # Gate G: the surviving workdir scrubs CLEAN — after repair, no
+    # chain/spill/quarantine artifact anywhere in the soak's output
+    # fails its digest (the quarantined rot itself sits in the
+    # excluded integrity-quarantine/ dir, preserved for triage).
+    scrub = subprocess.run(
+        [sys.executable, "-m", "attendance_tpu.cli", "scrub",
+         str(work)], cwd=str(REPO))
+    if scrub.returncode != 0:
+        return _fail(f"scrub over the surviving workdir exited "
+                     f"{scrub.returncode}")
+    print("[soak] gate G: surviving workdir scrubs clean", flush=True)
+    print("PASS: federation soak (dead-peer takeover, disk-rot "
+          "repair, partitioned peer, oracle-equal merged state, zero "
+          "false negatives, doctor + fleet + scrub gates)",
           flush=True)
     return 0
 
