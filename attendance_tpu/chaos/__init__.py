@@ -15,7 +15,7 @@ faults use ``fault=duration:prob``)::
     drop=0.01,delay=5ms:0.05,dup=0.005,conn_reset=0.002,
     persist_fail=0.01,writer_stall=200ms:0.01,corrupt=0.001,
     snap_fail=0.01,disk_corrupt=0.01,torn_write=0.01,enospc=0.01,
-    partition=2s:0.005
+    partition=2s:0.005,torn_slot=0.01
 
 ``off`` parses to a spec with every probability zero — the fault plane
 is INSTALLED (every hook runs against a live injector) but never fires;
@@ -61,6 +61,14 @@ Fault points (see README "Failure model" for the full table):
   keeps a ledger of every disk fault's path (``disk_faults``) so a
   soak can prove scrub detects 100% of the injections that survive
   on disk.
+* ``shm.slot`` — the shared-memory ring transport's publish seam
+  (transport/shm_ring): ``torn_slot`` leaves the slot mid-write
+  (sequence word odd, half the payload written) for a beat before
+  completing the publish — a concurrent reader must observe the torn
+  state and seqlock-retry, never deliver half a frame;
+  ``writer_stall`` parks the producer mid-write for the configured
+  duration (a stalled co-located writer stalls the ring — readers
+  wait, they do not tear).
 * ``transport.consume`` / ``fed.gossip`` — ``partition``
   (``partition=dur:p``): a one-way network blackhole window. On the
   consume side the consumer sees SILENCE for the duration (receives
@@ -84,7 +92,8 @@ from random import Random
 from typing import Dict, Optional, Tuple
 
 _PROB_FAULTS = ("drop", "dup", "conn_reset", "persist_fail", "corrupt",
-                "snap_fail", "disk_corrupt", "torn_write", "enospc")
+                "snap_fail", "disk_corrupt", "torn_write", "enospc",
+                "torn_slot")
 _TIMED_FAULTS = ("delay", "writer_stall", "partition")
 
 _DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|us)?$")
@@ -134,6 +143,7 @@ class ChaosSpec:
     disk_corrupt: float = 0.0   # post-fsync bit flip (storage rot)
     torn_write: float = 0.0     # post-fsync truncation (torn sector)
     enospc: float = 0.0         # OSError(ENOSPC) at the writer seam
+    torn_slot: float = 0.0      # shm ring slot left mid-write a beat
     delay: float = 0.0          # probability
     delay_s: float = 0.0        # duration per hit
     writer_stall: float = 0.0   # probability

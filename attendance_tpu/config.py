@@ -91,6 +91,37 @@ class Config:
     # numpy-vectorized batch scanner otherwise; "native"/"vector"
     # force one (codec.scan_json_batch_columns is the vector engine).
     lane_decode: str = "auto"
+    # Ingress wire selection (pipeline.codec / transport.shm_ring).
+    # "auto" (default) keeps the sniffing behavior: every broker
+    # payload routes through the codec its magic names (json, binary,
+    # COLW columnar) — producers pick the wire, consumers adapt per
+    # frame. "shm" replaces the broker transport for the EVENT topic
+    # with the shared-memory ring (co-located producers; --shm-dir
+    # names the ring directory, one ring file per ingress lane; the
+    # fed gossip/query planes keep their configured transports —
+    # a federated worker on shm ingress needs --fed-gossip-broker).
+    # "json"/"binary"/"columnar" are documentation of intent for
+    # broker wires (the consumer sniffs regardless).
+    ingress_wire: str = "auto"
+    # Shared-memory ring geometry (only read when ingress_wire=shm).
+    # One ring file per (topic, lane) under shm_dir; slots hold one
+    # planar frame each, so shm_slot_bytes must cover batch_size
+    # events (20 B/event + 8 B header; the producer fails loudly on
+    # overflow). nslots bounds the published-but-unacked window — the
+    # backpressure depth, and the redelivery bound after a consumer
+    # crash.
+    shm_dir: str = ""
+    shm_slots: int = 64
+    shm_slot_bytes: int = 1 << 21
+    # Classic-consumer JSON chunk decode (ISSUE 11 satellite): with
+    # ingress_lanes=0 a JSON payload used to decode PER MESSAGE inside
+    # the run loop (one event per dispatch on per-event wires). True
+    # (default) drains a whole chunk of JSON messages from chunk-
+    # capable consumers and batch-decodes them through the codec seam
+    # (the scan_json_batch_columns engine when the native list scan is
+    # unavailable). False keeps the per-message path — the bench's
+    # before/after measurement, and the bisection fallback.
+    json_chunk_decode: bool = True
     # Max time to wait filling a batch before flushing a partial one.
     batch_timeout_s: float = 0.05
     # Bloom layout: "flat" (standard double-hashed, Redis-parity FPR math)
@@ -342,6 +373,25 @@ class Config:
         if self.lane_decode not in ("auto", "native", "vector"):
             raise ValueError(
                 f"unknown lane decode engine: {self.lane_decode}")
+        if self.ingress_wire not in ("auto", "json", "binary",
+                                     "columnar", "shm"):
+            raise ValueError(
+                f"unknown ingress wire: {self.ingress_wire}")
+        if self.ingress_wire == "shm":
+            if not self.shm_dir:
+                raise ValueError(
+                    "--ingress-wire=shm needs --shm-dir (the ring-"
+                    "file directory both ends map)")
+            if self.fed_worker and not self.fed_gossip_broker:
+                raise ValueError(
+                    "a federated worker on shm ingress has no broker "
+                    "transport for gossip frames — set "
+                    "--fed-gossip-broker")
+        if self.shm_slots < 2:
+            raise ValueError("shm_slots must be >= 2 (ring depth)")
+        if self.shm_slot_bytes % 8 or self.shm_slot_bytes < 64:
+            raise ValueError(
+                "shm_slot_bytes must be a multiple of 8, >= 64")
         if self.snapshot_mode not in ("barrier", "delta"):
             raise ValueError(
                 f"unknown snapshot mode: {self.snapshot_mode}")
@@ -474,6 +524,30 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    help="lane JSON decode engine (auto = native "
                    "scanner when loadable, else the numpy-vectorized "
                    "batch scanner)")
+    p.add_argument("--ingress-wire",
+                   choices=["auto", "json", "binary", "columnar",
+                            "shm"],
+                   default=d.ingress_wire,
+                   help="ingress transport/wire: auto sniffs broker "
+                   "payloads per frame (json/binary/columnar all "
+                   "decode through the codec seam); shm consumes the "
+                   "shared-memory ring under --shm-dir instead of a "
+                   "broker (co-located zero-copy ingress)")
+    p.add_argument("--shm-dir", default=d.shm_dir,
+                   help="ring-file directory for --ingress-wire=shm "
+                   "(one ring per ingress lane; put it on /dev/shm "
+                   "for a memory-backed ring)")
+    p.add_argument("--shm-slots", type=int, default=d.shm_slots,
+                   help="slots per shm ring (the published-but-"
+                   "unacked backpressure window)")
+    p.add_argument("--shm-slot-bytes", type=int,
+                   default=d.shm_slot_bytes,
+                   help="bytes per shm ring slot (must fit one "
+                   "planar frame: ~20 B/event x batch-size)")
+    p.add_argument("--no-json-chunk-decode", action="store_true",
+                   help="classic consumer decodes JSON per message "
+                   "again (the pre-ISSUE-11 path; bench before/after "
+                   "and bisection only)")
     p.add_argument("--num-shards", type=int, default=d.num_shards)
     p.add_argument("--num-replicas", type=int, default=d.num_replicas)
     p.add_argument("--replica-sync", choices=["step", "query"],
@@ -657,6 +731,11 @@ def config_from_args(args: argparse.Namespace) -> Config:
         ingress_lanes=args.ingress_lanes,
         lane_queue_depth=args.lane_queue_depth,
         lane_decode=args.lane_decode,
+        ingress_wire=args.ingress_wire,
+        shm_dir=args.shm_dir,
+        shm_slots=args.shm_slots,
+        shm_slot_bytes=args.shm_slot_bytes,
+        json_chunk_decode=not args.no_json_chunk_decode,
         bloom_layout=args.bloom_layout,
         hll_precision=args.hll_precision,
         num_shards=args.num_shards,

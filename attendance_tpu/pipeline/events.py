@@ -45,6 +45,16 @@ assert BINARY_DTYPE.itemsize == 20
 BINARY_MAGIC = b"ATB1"  # frame prefix distinguishing binary from JSON ('{')
 
 
+def magic_match(data, magic: bytes) -> bool:
+    """``data.startswith(magic)`` for ANY buffer type: the shm ring
+    transport hands out zero-copy memoryviews over the mapped slots,
+    which have no ``startswith`` — and converting a whole multi-MB
+    frame to bytes just to sniff four magic bytes would defeat the
+    zero-copy contract.  Slicing a memoryview is O(magic)."""
+    head = data[:len(magic)]
+    return (head if isinstance(head, bytes) else bytes(head)) == magic
+
+
 @dataclass
 class AttendanceEvent:
     student_id: int
@@ -184,9 +194,9 @@ def decode_binary_batch(data: bytes,
     and discards it, reference attendance_processor.py:109-113 — no
     point allocating it per frame on the hot path).
     """
-    if data.startswith(PLANAR_MAGIC):
+    if magic_match(data, PLANAR_MAGIC):
         return decode_planar_batch(data, include_truth)
-    if not data.startswith(BINARY_MAGIC):
+    if not magic_match(data, BINARY_MAGIC):
         raise ValueError("not a binary event frame")
     rec = np.frombuffer(data, dtype=BINARY_DTYPE, offset=len(BINARY_MAGIC))
     cols = {
@@ -228,7 +238,7 @@ def encode_planar_batch(cols: Dict[str, np.ndarray]) -> bytes:
 def decode_planar_batch(data: bytes,
                         include_truth: bool = True) -> Dict[str, np.ndarray]:
     """Zero-copy decode: every column is a contiguous buffer view."""
-    if not data.startswith(PLANAR_MAGIC):
+    if not magic_match(data, PLANAR_MAGIC):
         raise ValueError("not a planar event frame")
     off = len(PLANAR_MAGIC)
     (n,) = np.frombuffer(data, np.uint32, count=1, offset=off)

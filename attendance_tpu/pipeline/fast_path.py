@@ -399,12 +399,18 @@ class FusedPipeline:
         # default) keeps every hook at one branch.
         from attendance_tpu import chaos
         self._chaos = chaos.ensure(self.config)
+        # Metrics exist before the transport: the classic consumer's
+        # chunk-decode wrapper settles poison payloads itself and must
+        # count them into THIS pipeline's nack/dead-letter totals.
+        self.metrics = ProcessorMetrics()
         self.client = client or make_client(self.config)
         if getattr(self.config, "ingress_lanes", 0) > 0:
             # Striped ingress plane (pipeline.lanes): N lane sessions
             # + bridge workers behind the one-consumer call shape this
             # run loop speaks; acks (incl. the snapshot writer's group
-            # commits) route back to each owning lane's session.
+            # commits) route back to each owning lane's session. With
+            # --ingress-wire=shm the client IS the shm ring client
+            # (make_client), so each lane maps its own ring file.
             from attendance_tpu.pipeline.lanes import StripedConsumer
             self.consumer = StripedConsumer(
                 self.config, self.client, self.config.pulsar_topic,
@@ -412,6 +418,21 @@ class FusedPipeline:
         else:
             self.consumer = self.client.subscribe(
                 self.config.pulsar_topic, self.SUBSCRIPTION)
+            if (getattr(self.config, "json_chunk_decode", True)
+                    and getattr(self.config, "ingress_wire",
+                                "auto") != "shm"
+                    and hasattr(self.consumer, "receive_many_raw")):
+                # Classic-consumer chunk decode (ISSUE 11 satellite):
+                # per-event JSON wires coalesce into one batched
+                # decode + one device dispatch per chunk instead of
+                # one per message; bulk binary frames pass through
+                # byte-identically (shm skips the wrapper — its slots
+                # are always planar frames already).
+                from attendance_tpu.pipeline.lanes import (
+                    JsonChunkConsumer)
+                self.consumer = JsonChunkConsumer(
+                    self.consumer, self.config, obs=self._obs,
+                    metrics=self.metrics)
         from attendance_tpu.storage import wrap_store
         self.store = wrap_store(store or ColumnarEventStore(),
                                 self.config, sink="columnar")
@@ -511,7 +532,6 @@ class FusedPipeline:
         # O(n) fancy-index instead of an O(n log n) np.unique per batch.
         self._day_base: Optional[int] = None
         self._day_lut = np.full(self._LUT_SIZE, -1, np.int32)
-        self.metrics = ProcessorMetrics()
         self._inflight = deque()
         # Snapshot/checkpoint wiring (dir empty = disabled). A set dir
         # with no interval still checkpoints (at a default cadence):
@@ -891,6 +911,14 @@ class FusedPipeline:
             # back to the ack chain, which probes .is_ready() on it.
             stored = (valid_n if perm is None
                       else _ScatterValidity(valid, perm, n))
+        if isinstance(data, memoryview):
+            # shm-ring frames: the slot recycles once its frame is
+            # acked, but the append-only store references inserted
+            # arrays forever — the stored columns must own their
+            # bytes. (Decode and the device dispatch above consumed
+            # the zero-copy views; this copies only the narrow stored
+            # columns, off the wire's critical path.)
+            cols = {k: np.array(v) for k, v in cols.items()}
         self.store.insert_columns({**cols, "is_valid": stored})
         self.metrics.batches += 1
         self.metrics.events += n

@@ -283,7 +283,9 @@ class IngressLane:
             # payload that LOOKS binary but won't parse (in-flight
             # corruption) counts as one event: this is a sizing
             # heuristic, and the poison path downstream owns the frame.
-            if codec_mod.codec_for_frame(toks[0][1]).name == "binary":
+            if codec_mod.codec_for_frame(toks[0][1]).name != "json":
+                # Bulk wires (binary, COLW columnar, shm slots) carry
+                # whole frames per message.
                 for tok in toks:
                     try:
                         total_events += codec_mod.frame_event_count(
@@ -409,7 +411,11 @@ class IngressLane:
         for tok in toks:
             payload = self._payload(tok)
             try:
-                if codec_mod.codec_for_frame(payload).name == "binary":
+                if codec_mod.codec_for_frame(payload).name != "json":
+                    # Binary AND columnar bulk frames: decode_frame
+                    # raises on a corrupt frame (COLW checksum/bounds
+                    # failures included), dead-lettering just that
+                    # frame below — never silently mutated events.
                     parts.append(codec_mod.decode_frame(payload))
                 else:
                     parts.append(columns_from_events(
@@ -479,6 +485,206 @@ class _NullMetrics:
 
     dead_lettered = 0
     nacked_batches = 0
+
+
+class CoalescedMessage:
+    """One classic-consumer coalesced JSON chunk: ``data()`` is the
+    assembled canonical planar frame; acks/nacks fan back to every
+    constituent broker message (raw tuples)."""
+
+    __slots__ = ("_data", "toks", "message_id", "redelivery_count",
+                 "_props")
+
+    def __init__(self, data: bytes, toks: List[tuple]):
+        self._data = data
+        self.toks = toks
+        self.message_id = (toks[0][0], toks[-1][0], len(toks))
+        self.redelivery_count = max(t[2] for t in toks)
+        self._props = toks[0][3]
+
+    def data(self) -> bytes:
+        return self._data
+
+    def properties(self):
+        return self._props
+
+
+class JsonChunkConsumer:
+    """Chunk decode for the CLASSIC (``--ingress-lanes=0``) consumer
+    (ISSUE 11 satellite: the socket JSON consumer still decoded per
+    message — one event per dispatch on per-event wires).
+
+    Wraps a ``receive_many_raw``-capable consumer behind the same
+    single-consumer call shape the run loop speaks.  ``receive``
+    drains raw messages in batches (restoring the socket prefetch
+    economics: one RPC per batch); bulk frames (binary / COLW / shm
+    slots) pass through one at a time untouched — byte-identical to
+    the unwrapped path — while a JSON payload triggers a whole-chunk
+    drain and ONE batched decode through the codec seam (the native
+    list scan when loadable, else ``scan_json_batch_columns``),
+    returning a :class:`CoalescedMessage` whose planar frame dispatches
+    as one device batch.  Poison payloads inside a chunk dead-letter
+    individually (the lane policy); settlement is per-id batches, so
+    the PR 4 group-commit acks release a coalesced frame's messages
+    in one broker op."""
+
+    _BULK_WANT = 16  # bulk-frame prefetch depth (SocketConsumer's)
+
+    def __init__(self, consumer, config, obs=None, metrics=None):
+        self.consumer = consumer
+        self.config = config
+        self._buf: deque = deque()
+        self._want = 1  # learn the wire from the first delivery
+        self._h_decode = (obs.stage("decode")
+                         if obs is not None else None)
+        self._tracer = obs.tracer if obs is not None else None
+        # The owning pipeline's ProcessorMetrics: poison payloads
+        # settled inside the wrapper must still count there (nack /
+        # dead-letter accounting is part of the classic consumer's
+        # observable contract).
+        self._metrics = metrics if metrics is not None \
+            else _NullMetrics()
+        from attendance_tpu.transport import PoisonTracker
+        self._poison = PoisonTracker()
+        self._engine = None  # resolved lazily: native scan vs vector
+
+    def _prefer_vector(self) -> bool:
+        if self._engine is None:
+            from attendance_tpu.native import load as load_native
+            nat = load_native()
+            self._engine = not (nat is not None
+                                and getattr(nat, "has_list_scan",
+                                            False))
+        return self._engine
+
+    def receive(self, timeout_millis: Optional[int] = None):
+        deadline = (None if timeout_millis is None
+                    else time.monotonic() + timeout_millis / 1e3)
+        while True:
+            rem_ms = (timeout_millis if deadline is None else
+                      max(1, int((deadline - time.monotonic()) * 1e3)))
+            if not self._buf:
+                self._buf.extend(self.consumer.receive_many_raw(
+                    self._want, timeout_millis=rem_ms))
+            first = self._buf[0][1]
+            if codec_mod.codec_for_frame(first).name != "json":
+                self._want = self._BULK_WANT
+                mid, data, red, props = self._buf.popleft()
+                return Message(data, mid, red, props)
+            # JSON wire: coalesce a whole chunk into one decode + one
+            # dispatch. Top up the buffer once (near-non-blocking) so
+            # a standing backlog fills full chunks even right after
+            # the learning request.
+            self._want = max(1, self.config.batch_size)
+            if len(self._buf) < self.config.batch_size:
+                try:
+                    self._buf.extend(self.consumer.receive_many_raw(
+                        self.config.batch_size - len(self._buf),
+                        timeout_millis=1))
+                except ReceiveTimeout:
+                    pass
+            toks = []
+            while self._buf and len(toks) < self.config.batch_size:
+                toks.append(self._buf.popleft())
+            t0 = time.perf_counter()
+            block = self._decode(toks)
+            if self._h_decode is not None:
+                self._h_decode.observe(time.perf_counter() - t0)
+            if block is not None:
+                return block
+            # Every payload in the chunk was poison (each settled
+            # individually above). Keep receiving inside the caller's
+            # window: an instantly-redelivered poison frame must reach
+            # its bounded dead-letter here, not ride a fake timeout
+            # out of the run loop's idle budget with backlog pending.
+            if deadline is not None and time.monotonic() >= deadline:
+                raise ReceiveTimeout(
+                    f"only poison within {timeout_millis}ms")
+
+    def _decode(self, toks) -> Optional[CoalescedMessage]:
+        payloads = [t[1] for t in toks]
+        try:
+            cols = codec_mod.CODECS["json"].decode(
+                payloads, prefer_gil_release=self._prefer_vector())
+        except Exception:
+            cols, toks = self._decode_poison(toks)
+            if cols is None:
+                return None
+        return CoalescedMessage(
+            codec_mod.CODECS["binary"].assemble(cols), toks)
+
+    def _decode_poison(self, toks):
+        """Mixed/poison chunk: decode per message so only the bad
+        payloads dead-letter (bounded by the poison tracker)."""
+        from attendance_tpu.pipeline.events import (
+            columns_from_events, decode_event)
+        good, parts = [], []
+        for tok in toks:
+            payload = tok[1]
+            try:
+                if codec_mod.codec_for_frame(payload).name != "json":
+                    parts.append(codec_mod.decode_frame(payload))
+                else:
+                    parts.append(columns_from_events(
+                        [decode_event(bytes(payload))]))
+                good.append(tok)
+            except Exception:
+                # count_nack=True: on the classic consumer the unit
+                # of nacking has always been one broker message. The
+                # classic tracing contract holds too: each poison
+                # attempt is a batch/retry span continuing the
+                # publisher's trace (redeliveries read as siblings
+                # under the original publish span).
+                span = None
+                if self._tracer is not None:
+                    now = time.perf_counter()
+                    span = self._tracer.begin_consume(
+                        tok[3], tok[2], role="fused-pipeline",
+                        start=now, got=now, wait_name="dequeue_wait",
+                        args={"bytes": len(tok[1])})
+                handle_poison(Message(tok[1], tok[0], tok[2], tok[3]),
+                              self.consumer, self._metrics,
+                              self.config, logger, count_nack=True,
+                              tracker=self._poison)
+                if span is not None:
+                    self._tracer.end_span(span, error=True)
+        if not good:
+            return None, ()
+        return codec_mod.merge_columns(parts), good
+
+    # -- settlement ---------------------------------------------------------
+    def acknowledge(self, msg) -> None:
+        if isinstance(msg, CoalescedMessage):
+            self.consumer.acknowledge_ids([t[0] for t in msg.toks])
+        else:
+            self.consumer.acknowledge(msg)
+
+    def acknowledge_many(self, msgs) -> None:
+        ids, singles = [], []
+        for m in msgs:
+            if isinstance(m, CoalescedMessage):
+                ids.extend(t[0] for t in m.toks)
+            else:
+                singles.append(m)
+        if ids:
+            self.consumer.acknowledge_ids(ids)
+        if singles:
+            from attendance_tpu.transport import acknowledge_all
+            acknowledge_all(self.consumer, singles)
+
+    def negative_acknowledge(self, msg) -> None:
+        if isinstance(msg, CoalescedMessage):
+            for mid, data, red, props in msg.toks:
+                self.consumer.negative_acknowledge(
+                    Message(data, mid, red, props))
+        else:
+            self.consumer.negative_acknowledge(msg)
+
+    def backlog(self) -> int:
+        return self.consumer.backlog() + len(self._buf)
+
+    def close(self) -> None:
+        self.consumer.close()
 
 
 class StripedConsumer:

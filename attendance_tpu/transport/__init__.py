@@ -232,6 +232,15 @@ def make_client(config):
     from attendance_tpu import chaos
 
     inj = chaos.ensure(config)
+    if getattr(config, "ingress_wire", "auto") == "shm":
+        # Shared-memory ring ingress (transport/shm_ring): the event
+        # topic's transport is the mmap'd ring, not a broker. The shm
+        # fault sites (torn_slot, writer_stall at shm.slot) live
+        # inside the producer; the byte-level proxies below are NOT
+        # applied — in-flight corruption is a socket-wire failure
+        # class, and the ring's seqlock already owns torn delivery.
+        from attendance_tpu.transport.shm_ring import ShmClient
+        return ShmClient.from_config(config)
     if config.transport_backend == "memory":
         client = MemoryClient(MemoryBroker.shared())
     elif config.transport_backend == "socket":

@@ -88,6 +88,17 @@ def test_socket_smoke():
     # The JSON bridge lane rides the same TCP broker (VERDICT r04 #4).
     assert r["json_events_per_sec"] > 0
     assert r["json_events"] > 0
+    # ISSUE 11 columns: direct-JSON before/after, the COLW columnar
+    # wire (with its measured-bytes honesty column), and the
+    # co-located shm ring.
+    assert r["json_direct_events_per_sec"] > 0
+    assert r["json_direct_permsg_events_per_sec"] > 0
+    assert r["colw_events_per_sec"] > 0
+    assert 0 < r["colw_bytes_per_event"] <= 8.0
+    assert r["colw_bytes_gate_pass"]
+    assert r["shm_events_per_sec"] > 0
+    assert isinstance(r["shm_gate"], str)
+    assert isinstance(r["colw_gate"], str)
 
 
 def test_roster10m_tpu_smoke():

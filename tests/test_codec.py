@@ -187,3 +187,182 @@ def test_vector_scanner_empty_and_bounds():
 def test_vector_scanner_raises_on_malformed_json():
     with pytest.raises(Exception):
         codec.scan_json_batch_columns([b"not json at all"])
+
+
+# ---------------------------------------------------------------------------
+# COLW columnar wire (ISSUE 11): differential identity vs the JSON and
+# binary oracles, fallback/out-of-range rows, loud corruption failure
+# ---------------------------------------------------------------------------
+
+def _colw_cols(n=512, seed=3, arrival=True):
+    rng = np.random.default_rng(seed)
+    if arrival:
+        micros = (1_753_000_000_000_000
+                  + np.cumsum(rng.integers(1, 2_000, n))).astype(
+                      np.int64)
+    else:
+        micros = (1_753_000_000_000_000
+                  + rng.integers(0, 86_400_000_000, n)).astype(np.int64)
+    return {
+        "student_id": rng.integers(10_000, 410_000, n,
+                                   dtype=np.uint32),
+        "lecture_day": (20_260_701
+                        + rng.integers(0, 8, n)).astype(np.uint32),
+        "micros": micros,
+        "is_valid": rng.random(n) < 0.9,
+        "event_type": (rng.random(n) < 0.5).astype(np.int8),
+    }
+
+
+def _events_from_cols(cols):
+    """The same logical events as reference-wire JSON payloads (the
+    oracle the differential tests compare against)."""
+    from datetime import datetime, timezone
+    out = []
+    for i in range(len(cols["student_id"])):
+        ts = datetime.fromtimestamp(
+            int(cols["micros"][i]) / 1e6,
+            tz=timezone.utc).replace(tzinfo=None)
+        out.append(AttendanceEvent(
+            int(cols["student_id"][i]),
+            ts.isoformat(),
+            f"LECTURE_{int(cols['lecture_day'][i])}",
+            bool(cols["is_valid"][i]),
+            "exit" if cols["event_type"][i] else "entry"))
+    return out
+
+
+@pytest.mark.parametrize("arrival", [True, False])
+def test_colw_differential_vs_json_and_binary_oracles(arrival):
+    cols = _colw_cols(arrival=arrival)
+    colw = codec.encode_columnar_batch(cols)
+    got = codec.decode_columnar_frame(colw)
+    # vs the binary (planar) oracle
+    planar = encode_planar_batch(cols)
+    _assert_cols_equal(got, decode_binary_batch(planar))
+    # vs the JSON oracle over the same logical events
+    payloads = [encode_event(e) for e in _events_from_cols(cols)]
+    _assert_cols_equal(got, decode_json_batch_columns(payloads))
+    # and the codec-seam entry points route it identically
+    _assert_cols_equal(got, codec.decode_frame(colw))
+    _assert_cols_equal(got,
+                       codec.get_codec("columnar").decode([colw]))
+
+
+def test_colw_out_of_range_timestamps_roundtrip():
+    """Deltas past every narrow width (negative epochs, +/-2^62
+    micros, out-of-order rows) fall back to the 8-byte width and
+    round-trip exactly."""
+    cols = _colw_cols(64)
+    m = cols["micros"].copy()
+    m[1] = -(2 ** 62)
+    m[2] = 2 ** 62
+    m[3] = 0
+    cols["micros"] = m
+    out = codec.decode_columnar_frame(codec.encode_columnar_batch(cols))
+    assert np.array_equal(out["micros"], m)
+
+
+def test_colw_both_id_modes_exercised_and_identical():
+    n = 512
+    cols = _colw_cols(n)
+    # lecture_day: 8 uniques over 512 rows -> dictionary wins;
+    # student_id: ~unique over a wide range -> width-packing wins.
+    body = codec.encode_columnar_batch(cols, checksum=False)
+    # one of each mode byte must appear (sanity that the test really
+    # covers both encoders)
+    got = codec.decode_columnar_frame(body)
+    _assert_cols_equal(got, cols)
+    # force dictionary on students too (tiny roster, repeated ids)
+    rng = np.random.default_rng(0)
+    cols2 = dict(cols, student_id=rng.choice(
+        np.array([7, 9, 11], np.uint32), n))
+    got2 = codec.decode_columnar_frame(
+        codec.encode_columnar_batch(cols2))
+    _assert_cols_equal(got2, cols2)
+
+
+def test_colw_empty_and_single_row():
+    for n in (0, 1):
+        cols = {k: v[:n] for k, v in _colw_cols(8).items()}
+        out = codec.decode_columnar_frame(
+            codec.encode_columnar_batch(cols))
+        _assert_cols_equal(out, cols)
+
+
+def test_colw_corruption_rejected_loudly():
+    """A flipped byte anywhere in a checksummed COLW frame raises at
+    decode (FrameChecksumError is a ValueError) — the poison path's
+    trigger; silent event mutation is impossible by construction."""
+    from attendance_tpu.transport.framing import FrameChecksumError
+    colw = bytearray(codec.encode_columnar_batch(_colw_cols(128)))
+    for pos in (5, 40, len(colw) // 2, len(colw) - 3):
+        bad = bytearray(colw)
+        bad[pos] ^= 0x40
+        with pytest.raises((FrameChecksumError, ValueError)):
+            codec.decode_columnar_frame(bytes(bad))
+
+
+def test_colw_dictionary_miss_fails_loudly():
+    """A dictionary index past the dictionary (hand-corrupted BARE
+    body, so no checksum catches it first) must raise, never guess a
+    value."""
+    n = 64
+    rng = np.random.default_rng(1)
+    # Wide values at tiny cardinality: dictionary mode wins (packing
+    # would need 3 bytes/row; the dict costs one index byte).
+    cols = dict(_colw_cols(n),
+                student_id=rng.choice(
+                    np.array([100_000, 200_000], np.uint32), n))
+    body = bytearray(codec.encode_columnar_batch(cols, checksum=False))
+    # find the student dict column: mode byte 0x01 after the ts block.
+    # ts block: magic(4) + n(4) + base(8) + w(1) + deltas
+    ts_w = body[16]
+    off = 17 + (n - 1) * ts_w
+    assert body[off] == 1, "expected dictionary mode for students"
+    k = int.from_bytes(body[off + 1:off + 5], "little")
+    iw = body[off + 5 + 4 * k]
+    idx0 = off + 5 + 4 * k + 1
+    body[idx0] = 0xFF  # index 255 >> k
+    with pytest.raises(ValueError, match="dictionary index"):
+        codec.decode_columnar_frame(bytes(body))
+
+
+def test_colw_truncation_fails_loudly():
+    body = codec.encode_columnar_batch(_colw_cols(128), checksum=False)
+    for cut in (6, 20, len(body) // 2, len(body) - 1):
+        with pytest.raises(ValueError):
+            codec.decode_columnar_frame(body[:cut])
+    with pytest.raises(ValueError, match="trailing"):
+        codec.decode_columnar_frame(body + b"\x00")
+
+
+def test_colw_frame_event_count_and_sniff():
+    cols = _colw_cols(200)
+    wrapped = codec.encode_columnar_batch(cols)
+    bare = codec.encode_columnar_batch(cols, checksum=False)
+    for f in (wrapped, bare, memoryview(wrapped)):
+        assert codec.frame_event_count(f) == 200
+        assert codec.codec_for_frame(f).name == "columnar"
+    assert codec.columnar_wire_bytes_per_event([wrapped]) == \
+        pytest.approx(len(wrapped) / 200)
+
+
+def test_colw_multi_payload_decode_merges():
+    a, b = _colw_cols(64, seed=1), _colw_cols(32, seed=2)
+    got = codec.get_codec("columnar").decode(
+        [codec.encode_columnar_batch(a), codec.encode_columnar_batch(b)])
+    want = codec.merge_columns([a, b])
+    _assert_cols_equal(got, want)
+
+
+def test_colw_hostile_event_count_rejected_before_allocation():
+    """A corrupt bare header claiming 2^32-1 events must raise at the
+    bounds check, never attempt the multi-GB column allocation (the
+    unchecksummed legacy-tolerance path is exactly where a mangled
+    count can reach the decoder)."""
+    import struct
+    hostile = codec.COLW_MAGIC + struct.pack("<I", 0xFFFFFFFF) \
+        + b"\x00" * 16
+    with pytest.raises(ValueError, match="impossible"):
+        codec.decode_columnar_frame(hostile)

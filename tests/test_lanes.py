@@ -286,3 +286,147 @@ def test_chaos_on_lane_subset_self_heals(server):
     assert all(t > 0 for t in totals), totals
     pipe.cleanup()
     pub_client.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: COLW columnar wire through the lanes + the classic chunk
+# decode
+# ---------------------------------------------------------------------------
+
+def _colw_frames(n_frames, per_frame, roster, seed=0):
+    from attendance_tpu.pipeline.codec import encode_columnar_batch
+    rng = np.random.default_rng(seed)
+    frames, all_cols = [], []
+    base = 1_753_000_000_000_000
+    for _ in range(n_frames):
+        micros = base + np.cumsum(
+            rng.integers(1, 2_000, per_frame)).astype(np.int64)
+        base = int(micros[-1]) + 1
+        cols = {
+            "student_id": roster[rng.integers(0, len(roster),
+                                              per_frame)],
+            "lecture_day": (20_260_701 + rng.integers(
+                0, 4, per_frame)).astype(np.uint32),
+            "micros": micros,
+            "is_valid": np.ones(per_frame, bool),
+            "event_type": np.zeros(per_frame, np.int8),
+        }
+        all_cols.append(cols)
+        frames.append(encode_columnar_batch(cols))
+    return frames, all_cols
+
+
+@pytest.mark.parametrize("lanes", [0, 2])
+def test_columnar_wire_matches_binary_oracle(lanes):
+    """COLW frames land event-identical to the same columns shipped as
+    planar binary — classic consumer and striped lanes both."""
+    from attendance_tpu.pipeline.events import encode_planar_batch
+    rng = np.random.default_rng(5)
+    roster = rng.choice(np.arange(10_000, 60_000, dtype=np.uint32),
+                        800, replace=False)
+    colw, all_cols = _colw_frames(8, 1024, roster)
+    nev = 8 * 1024
+    results = {}
+    for wire, frames in (("columnar", colw),
+                         ("binary", [encode_planar_batch(c)
+                                     for c in all_cols])):
+        config = Config(bloom_filter_capacity=10_000, batch_size=1024,
+                        ingress_lanes=lanes,
+                        pulsar_topic=f"colw-{lanes}-{wire}").validate()
+        pipe = _run_pipeline(config, MemoryBroker(), frames=frames,
+                             roster=roster, max_events=nev,
+                             idle_timeout_s=1.0)
+        assert pipe.metrics.events == nev
+        assert pipe.metrics.dead_lettered == 0
+        results[wire] = pipe.count_all()
+        pipe.cleanup()
+    assert results["columnar"] == results["binary"]
+
+
+def test_columnar_corrupt_frame_dead_letters_never_mutates():
+    """A corrupt COLW frame mid-backlog dead-letters LOUDLY (checksum
+    reject -> poison path) while every clean frame folds — final state
+    equals the clean-frames-only oracle, proving no silent event
+    mutation leaked through."""
+    rng = np.random.default_rng(6)
+    roster = rng.choice(np.arange(10_000, 60_000, dtype=np.uint32),
+                        500, replace=False)
+    colw, _ = _colw_frames(6, 512, roster)
+    corrupt = bytearray(colw[3])
+    corrupt[len(corrupt) // 2] ^= 0xFF
+    backlog = colw[:3] + [bytes(corrupt)] + colw[3:]
+
+    def run(frames, topic):
+        config = Config(bloom_filter_capacity=10_000, batch_size=512,
+                        ingress_lanes=2, max_redeliveries=2,
+                        pulsar_topic=topic).validate()
+        pipe = _run_pipeline(config, MemoryBroker(), frames=frames,
+                             roster=roster, max_events=6 * 512,
+                             idle_timeout_s=1.5)
+        stats = (pipe.metrics.events, pipe.count_all())
+        pipe.cleanup()
+        return stats
+
+    got_events, got_counts = run(backlog, "colw-corrupt")
+    want_events, want_counts = run(colw, "colw-clean")
+    assert got_events == want_events == 6 * 512
+    assert got_counts == want_counts
+
+
+def test_classic_json_chunk_decode_matches_per_message_path():
+    """ISSUE 11 satellite: the classic (lanes=0) consumer batch-
+    decodes JSON chunks through the codec seam; results are identical
+    to the per-message path it replaces (kept reachable via
+    json_chunk_decode=False)."""
+    rng = np.random.default_rng(7)
+    roster = rng.choice(np.arange(10_000, 60_000, dtype=np.uint32),
+                        400, replace=False)
+    payloads = _json_payloads(1200, roster, seed=7)
+    results = {}
+    for chunked in (True, False):
+        config = Config(bloom_filter_capacity=10_000, batch_size=256,
+                        json_chunk_decode=chunked,
+                        pulsar_topic=f"jchunk-{chunked}").validate()
+        pipe = _run_pipeline(config, MemoryBroker(), payloads=payloads,
+                             roster=roster, max_events=len(payloads),
+                             idle_timeout_s=1.0)
+        assert pipe.metrics.events == len(payloads)
+        results[chunked] = pipe.count_all()
+        # chunked: dispatches are coalesced (far fewer batches than
+        # messages); per-message: one batch per message.
+        if chunked:
+            assert pipe.metrics.batches < len(payloads) / 4
+        else:
+            assert pipe.metrics.batches == len(payloads)
+        pipe.cleanup()
+    assert results[True] == results[False]
+    exact = _exact_counts(payloads)
+    for day, est in results[True].items():
+        assert abs(est - exact[day]) <= max(3, 0.05 * exact[day])
+
+
+def test_classic_chunk_consumer_mixed_wires_in_order():
+    """A topic mixing bulk binary frames and per-event JSON payloads
+    through the classic chunk consumer: everything lands, binary
+    passes through untouched."""
+    rng = np.random.default_rng(8)
+    roster = rng.choice(np.arange(10_000, 60_000, dtype=np.uint32),
+                        300, replace=False)
+    jsons = _json_payloads(600, roster, seed=8)
+    broster, frames = generate_frames(2 * 512, 512, roster_size=300,
+                                      num_lectures=4, seed=8)
+    config = Config(bloom_filter_capacity=10_000, batch_size=256,
+                    pulsar_topic="mixed-chunk").validate()
+    broker = MemoryBroker()
+    pipe = FusedPipeline(config, client=MemoryClient(broker),
+                         num_banks=8)
+    pipe.preload(np.union1d(roster, broster))
+    producer = MemoryClient(broker).create_producer(config.pulsar_topic)
+    producer.send_many(jsons[:300])
+    for f in frames:
+        producer.send(f)
+    producer.send_many(jsons[300:])
+    pipe.run(max_events=600 + 2 * 512, idle_timeout_s=1.0)
+    assert pipe.metrics.events == 600 + 2 * 512
+    assert pipe.metrics.dead_lettered == 0
+    pipe.cleanup()

@@ -1,0 +1,360 @@
+"""Shared-memory ring transport tests (ISSUE 11): seqlock integrity
+under a concurrent writer (the hammer), wrap-around + full-ring
+backpressure, cursor-resume redelivery after a dead consumer, the
+chaos fault sites (torn_slot / writer_stall), and the striped-lane
+end-to-end against the broker oracle."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from attendance_tpu.chaos import ChaosInjector, ChaosSpec
+from attendance_tpu.transport.memory_broker import ReceiveTimeout
+from attendance_tpu.transport.shm_ring import (
+    ShmClient, ShmRingConsumer, ShmRingFull, ShmRingProducer, ring_path)
+
+
+def _ring_pair(tmp_path, *, nslots=8, slot_bytes=4096, chaos=None):
+    path = tmp_path / "t.lane0.ring"
+    prod = ShmRingProducer(path, nslots=nslots, slot_bytes=slot_bytes,
+                           chaos=chaos)
+    cons = ShmRingConsumer(path, nslots=nslots, slot_bytes=slot_bytes)
+    return path, prod, cons
+
+
+def test_roundtrip_and_ack_cursor(tmp_path):
+    path, prod, cons = _ring_pair(tmp_path)
+    for i in range(5):
+        prod.send(b"frame-%d" % i)
+    msgs = [cons.receive(timeout_millis=200) for _ in range(5)]
+    assert [bytes(m.data()) for m in msgs] == \
+        [b"frame-%d" % i for i in range(5)]
+    assert [m.redelivery_count for m in msgs] == [0] * 5
+    cons.acknowledge_many(msgs)
+    with pytest.raises(ReceiveTimeout):
+        cons.receive(timeout_millis=20)
+    assert cons.backlog() == 0
+    cons.close()
+    # Everything acked: a fresh attach redelivers nothing.
+    cons2 = ShmRingConsumer(path, nslots=8, slot_bytes=4096)
+    with pytest.raises(ReceiveTimeout):
+        cons2.receive(timeout_millis=20)
+    cons2.close()
+    prod.close()
+
+
+def test_wraparound_many_times_over(tmp_path):
+    """Sequences wrap the slot array many times; every frame arrives
+    exactly once, in order (ack keeps the window open)."""
+    _, prod, cons = _ring_pair(tmp_path, nslots=4)
+    got = []
+
+    def consume():
+        while len(got) < 64:
+            m = cons.receive(timeout_millis=500)
+            got.append(bytes(m.data()))
+            cons.acknowledge(m)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    for i in range(64):
+        prod.send(b"wrap-%03d" % i)
+    t.join(timeout=10)
+    assert got == [b"wrap-%03d" % i for i in range(64)]
+    prod.close()
+    cons.close()
+
+
+def test_full_ring_backpressure(tmp_path):
+    """An unacked ring blocks the producer (ShmRingFull on timeout) —
+    backpressure, never overwrite; one ack frees exactly one slot."""
+    _, prod, cons = _ring_pair(tmp_path, nslots=4)
+    for i in range(4):
+        prod.send(b"x%d" % i)
+    with pytest.raises(ShmRingFull):
+        prod.send(b"overflow", timeout_s=0.1)
+    m = cons.receive(timeout_millis=100)
+    cons.acknowledge(m)
+    prod.send(b"now-fits", timeout_s=1.0)  # freed slot admits one
+    with pytest.raises(ShmRingFull):
+        prod.send(b"overflow-again", timeout_s=0.1)
+    prod.close()
+    cons.close()
+
+
+def test_oversized_frame_rejected(tmp_path):
+    _, prod, cons = _ring_pair(tmp_path, slot_bytes=256)
+    with pytest.raises(ValueError, match="slot"):
+        prod.send(b"z" * 300)
+    prod.close()
+    cons.close()
+
+
+def test_geometry_mismatch_fails_loudly(tmp_path):
+    path, prod, cons = _ring_pair(tmp_path, nslots=8)
+    with pytest.raises(ValueError, match="geometry"):
+        ShmRingConsumer(path, nslots=16, slot_bytes=4096)
+    prod.close()
+    cons.close()
+
+
+def test_nack_redelivers_with_bumped_count(tmp_path):
+    _, prod, cons = _ring_pair(tmp_path)
+    prod.send(b"poisonish")
+    m = cons.receive(timeout_millis=100)
+    assert m.redelivery_count == 0
+    cons.negative_acknowledge(m)
+    m2 = cons.receive(timeout_millis=100)
+    assert bytes(m2.data()) == b"poisonish"
+    assert m2.message_id == m.message_id  # stable identity (tracker)
+    assert m2.redelivery_count == 1
+    cons.acknowledge(m2)
+    prod.close()
+    cons.close()
+
+
+def test_crash_resume_redelivers_unacked_tail(tmp_path):
+    """Consumer dies (close == SIGKILL for cursor purposes: nothing is
+    flushed beyond what acks already persisted) holding unacked
+    frames; the next attach resumes from the durable cursor and
+    redelivers exactly the unacked tail, in order."""
+    path, prod, cons = _ring_pair(tmp_path)
+    for i in range(6):
+        prod.send(b"r%d" % i)
+    msgs = [cons.receive(timeout_millis=100) for _ in range(6)]
+    cons.acknowledge_many(msgs[:2])  # group commit covered 0-1 only
+    cons.close()
+    cons2 = ShmRingConsumer(path, nslots=8, slot_bytes=4096)
+    redelivered = [cons2.receive(timeout_millis=100) for _ in range(4)]
+    assert [bytes(m.data()) for m in redelivered] == \
+        [b"r%d" % i for i in range(2, 6)]
+    assert all(m.redelivery_count == 1 for m in redelivered)
+    cons2.acknowledge_many(redelivered)
+    with pytest.raises(ReceiveTimeout):
+        cons2.receive(timeout_millis=20)
+    cons2.close()
+    prod.close()
+
+
+def test_out_of_order_acks_hold_cursor(tmp_path):
+    """The durable cursor advances only over the contiguous acked
+    prefix: a hole (in-flight frame) keeps everything behind it
+    redeliverable after a crash."""
+    path, prod, cons = _ring_pair(tmp_path)
+    for i in range(4):
+        prod.send(b"h%d" % i)
+    msgs = [cons.receive(timeout_millis=100) for _ in range(4)]
+    cons.acknowledge(msgs[0])
+    cons.acknowledge(msgs[2])  # hole at seq 1
+    cons.acknowledge(msgs[3])
+    cons.close()
+    cons2 = ShmRingConsumer(path, nslots=8, slot_bytes=4096)
+    redelivered = [cons2.receive(timeout_millis=100) for _ in range(3)]
+    assert [bytes(m.data()) for m in redelivered] == [b"h1", b"h2",
+                                                      b"h3"]
+    cons2.close()
+    prod.close()
+
+
+def test_seqlock_hammer_zero_torn_deliveries(tmp_path):
+    """The hammer: a writer races the reader over a tiny ring for many
+    wraps; every delivered payload must be internally consistent (one
+    repeated byte + its sequence) — zero torn reads DELIVERED.  Torn
+    observations (retries) are allowed and counted."""
+    _, prod, cons = _ring_pair(tmp_path, nslots=4, slot_bytes=8192)
+    n_msgs, payload_len = 300, 4096
+    errors = []
+
+    def consume():
+        for i in range(n_msgs):
+            m = cons.receive(timeout_millis=2000)
+            buf = np.frombuffer(m.data(), np.uint8)
+            seq = int.from_bytes(bytes(buf[:8]), "little")
+            if seq != i or not (buf[8:] == buf[8]).all() \
+                    or buf[8] != seq % 251:
+                errors.append((i, seq, int(buf[8])))
+            cons.acknowledge(m)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    for i in range(n_msgs):
+        body = i.to_bytes(8, "little") + bytes([i % 251]) * (
+            payload_len - 8)
+        prod.send(body)
+    t.join(timeout=30)
+    assert not t.is_alive(), "consumer wedged"
+    assert errors == [], f"torn deliveries: {errors[:5]}"
+    prod.close()
+    cons.close()
+
+
+def test_torn_slot_chaos_retried_never_delivered(tmp_path):
+    """torn_slot=1.0: EVERY publish leaves the slot visibly mid-write
+    for a beat; a concurrent reader must retry (torn observations
+    counted) and still deliver every frame intact."""
+    inj = ChaosInjector(ChaosSpec.parse("torn_slot=1.0"), seed=7)
+    _, prod, cons = _ring_pair(tmp_path, nslots=4, slot_bytes=8192,
+                               chaos=inj)
+    n_msgs = 24
+    got = []
+
+    def consume():
+        for _ in range(n_msgs):
+            m = cons.receive(timeout_millis=2000)
+            buf = bytes(m.data())
+            got.append(buf)
+            cons.acknowledge(m)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    want = []
+    for i in range(n_msgs):
+        body = bytes([i % 251]) * 4000
+        want.append(body)
+        prod.send(body)
+    t.join(timeout=30)
+    assert got == want
+    assert inj.injected_total("torn_slot") == n_msgs
+    # The reader raced at least one mid-write slot and retried it.
+    assert cons.torn_reads > 0
+    prod.close()
+    cons.close()
+
+
+def test_writer_stall_chaos_stalls_not_corrupts(tmp_path):
+    inj = ChaosInjector(ChaosSpec.parse("writer_stall=30ms:1.0"),
+                        seed=7)
+    _, prod, cons = _ring_pair(tmp_path, chaos=inj)
+    got = []
+
+    def consume():
+        for _ in range(3):
+            m = cons.receive(timeout_millis=2000)
+            got.append(bytes(m.data()))
+            cons.acknowledge(m)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    for i in range(3):
+        prod.send(b"stalled-%d" % i)
+    t.join(timeout=10)
+    assert got == [b"stalled-%d" % i for i in range(3)]
+    assert inj.injected_total("writer_stall") == 3
+    prod.close()
+    cons.close()
+
+
+def test_chunk_lane_settlement(tmp_path):
+    """receive_chunk / acknowledge_chunk / nack_chunk — the call shape
+    the striped lane workers speak."""
+    _, prod, cons = _ring_pair(tmp_path)
+    for i in range(4):
+        prod.send(b"c%d" % i)
+    cid, toks = cons.receive_chunk(4, timeout_millis=200)
+    assert [bytes(t[1]) for t in toks] == [b"c%d" % i for i in range(4)]
+    cons.nack_chunk(cid)
+    cid2, toks2 = cons.receive_chunk(4, timeout_millis=200)
+    assert [t[2] for t in toks2] == [1, 1, 1, 1]  # redelivered once
+    cons.acknowledge_chunk(cid2)
+    with pytest.raises(ReceiveTimeout):
+        cons.receive_chunk(4, timeout_millis=20)
+    prod.close()
+    cons.close()
+
+
+def test_shm_client_lane_striping(tmp_path):
+    """The client stripes producer sends round-robin over lane rings
+    and lane subscriptions map the matching files."""
+    client = ShmClient(tmp_path, lanes=2, nslots=8, slot_bytes=4096)
+    prod = client.create_producer("topic-x")
+    for i in range(6):
+        prod.send(b"s%d" % i)
+    c0 = client.subscribe_lane("topic-x", "sub", 0)
+    c1 = client.subscribe_lane("topic-x", "sub", 1)
+    lane0 = [bytes(c0.receive(timeout_millis=100).data())
+             for _ in range(3)]
+    lane1 = [bytes(c1.receive(timeout_millis=100).data())
+             for _ in range(3)]
+    assert lane0 == [b"s0", b"s2", b"s4"]
+    assert lane1 == [b"s1", b"s3", b"s5"]
+    assert ring_path(tmp_path, "topic-x", 0).exists()
+    assert ring_path(tmp_path, "topic-x", 1).exists()
+    client.close()
+
+
+@pytest.mark.slow
+def test_striped_shm_pipeline_matches_oracle(tmp_path):
+    """End to end: 2-lane shm ingress == the memory-broker oracle on
+    the same workload (sketch counts, store rows, valid totals)."""
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.transport import make_client
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    nev, batch = 16_384, 2048
+
+    def state(pipe):
+        df = pipe.store.to_dataframe()
+        return ({int(d): pipe.count(int(d))
+                 for d in pipe.lecture_days()},
+                len(df), int(df.is_valid.sum()))
+
+    cfg = Config(bloom_filter_capacity=50_000, ingress_wire="shm",
+                 shm_dir=str(tmp_path), ingress_lanes=2,
+                 shm_slots=8, shm_slot_bytes=1 << 21).validate()
+    roster, frames = generate_frames(nev, batch, roster_size=10_000,
+                                     num_lectures=8)
+    frames = list(frames)
+    pipe = FusedPipeline(cfg, num_banks=8)
+    pipe.preload(roster)
+    producer = make_client(cfg).create_producer(cfg.pulsar_topic)
+    t = threading.Thread(
+        target=lambda: [producer.send(f) for f in frames])
+    t.start()
+    pipe.run(max_events=nev, idle_timeout_s=2.0)
+    t.join()
+    assert pipe.metrics.events == nev
+    shm_state = state(pipe)
+    lane_totals = pipe.consumer.lane_event_totals()
+    pipe.cleanup()
+    assert sum(lane_totals) == nev and all(lane_totals)
+
+    client = MemoryClient(MemoryBroker())
+    ocfg = Config(bloom_filter_capacity=50_000,
+                  transport_backend="memory")
+    opipe = FusedPipeline(ocfg, client=client, num_banks=8)
+    oroster, oframes = generate_frames(nev, batch, roster_size=10_000,
+                                       num_lectures=8)
+    opipe.preload(oroster)
+    op = client.create_producer(ocfg.pulsar_topic)
+    for f in oframes:
+        op.send(f)
+    opipe.run(max_events=nev, idle_timeout_s=2.0)
+    assert state(opipe) == shm_state
+    opipe.cleanup()
+
+
+def test_producer_crash_between_stamp_and_head_bump_never_overwrites(
+        tmp_path):
+    """A producer killed between the stable seqword stamp (publish
+    point) and the head bump must NOT overwrite that published slot on
+    restart: attach reconstructs head by scanning stable seqwords."""
+    from attendance_tpu.transport.shm_ring import _Ring
+    path, prod, cons = _ring_pair(tmp_path)
+    prod.send(b"published-0")
+    prod.send(b"published-1")
+    # Simulate the crash window: rewind the header head to pretend the
+    # dead producer never recorded its last publish.
+    prod._ring.set_head(1)
+    prod.close()
+    prod2 = ShmRingProducer(path, nslots=8, slot_bytes=4096)
+    assert prod2._head == 2  # scan found the uncounted published slot
+    prod2.send(b"published-2")
+    got = [bytes(cons.receive(timeout_millis=200).data())
+           for _ in range(3)]
+    assert got == [b"published-0", b"published-1", b"published-2"]
+    prod2.close()
+    cons.close()

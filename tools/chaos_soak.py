@@ -65,28 +65,56 @@ ROT_SPEC = ("drop=0.02,dup=0.02,conn_reset=0.02,corrupt=0.02,"
             "snap_fail=0.05,writer_stall=20ms:0.05,"
             "disk_corrupt=0.08,torn_write=0.04,enospc=0.02,"
             "partition=600ms:0.05")
+# The shm-transport soak (``--spec shm``, ISSUE 11): torn slots +
+# stalled writer against the mmap ring, with a REAL SIGKILL of the
+# consumer mid-ring and a cursor-resume recovery (run_shm_soak).
+SHM_SPEC = "torn_slot=0.08,writer_stall=15ms:0.05"
 NUM_EVENTS, BATCH = 32_768, 512
 ROSTER, LECTURES = 10_000, 8
 POISON_FRAMES = 2
 DATA_SEED_BASE = 7_000  # frame-content seed space, disjoint per soak seed
 
 
-def _frames(seed: int):
+def _frames(seed: int, wire: str = "binary"):
     from attendance_tpu.pipeline.loadgen import generate_frames
 
-    return generate_frames(NUM_EVENTS, BATCH, roster_size=ROSTER,
-                           num_lectures=LECTURES, invalid_fraction=0.1,
-                           seed=DATA_SEED_BASE + seed)
+    roster, frames = generate_frames(
+        NUM_EVENTS, BATCH, roster_size=ROSTER,
+        num_lectures=LECTURES, invalid_fraction=0.1,
+        seed=DATA_SEED_BASE + seed)
+    if wire == "columnar":
+        from attendance_tpu.pipeline.codec import encode_columnar_batch
+        from attendance_tpu.pipeline.events import decode_planar_batch
+        frames = [encode_columnar_batch(decode_planar_batch(f))
+                  for f in frames]
+    return roster, frames
 
 
-def _poison_frames(seed: int):
-    """Deterministically undecodable frames (bad magic): the quarantine
-    path's workload."""
+def _poison_frames(seed: int, wire: str = "binary"):
+    """Deterministically undecodable frames: bad-magic garbage (the
+    classic quarantine workload) and, on the columnar wire, a COLW
+    frame whose checksum no longer matches its body — persistent wire
+    rot that must dead-letter LOUDLY after bounded retries, never fold
+    as silently mutated events."""
     import numpy as np
 
     rng = np.random.default_rng(900_000 + seed)
-    return [b"ATPX" + rng.bytes(64 + 32 * i)
-            for i in range(POISON_FRAMES)]
+    frames = [b"ATPX" + rng.bytes(64 + 32 * i)
+              for i in range(POISON_FRAMES)]
+    if wire == "columnar":
+        from attendance_tpu.pipeline.codec import encode_columnar_batch
+        cols = {
+            "student_id": rng.integers(10_000, 20_000, 64,
+                                       dtype=np.uint32),
+            "lecture_day": np.full(64, 20_260_701, np.uint32),
+            "micros": np.arange(64, dtype=np.int64) + 10 ** 15,
+            "is_valid": np.ones(64, bool),
+            "event_type": np.zeros(64, np.int8),
+        }
+        rotted = bytearray(encode_columnar_batch(cols))
+        rotted[len(rotted) // 2] ^= 0x55
+        frames.append(bytes(rotted))
+    return frames
 
 
 def _state(pipe) -> dict:
@@ -104,7 +132,7 @@ def _counter_total(registry, name: str) -> float:
     return total
 
 
-def _oracle(seed: int) -> dict:
+def _oracle(seed: int, wire: str = "binary") -> dict:
     from attendance_tpu.config import Config
     from attendance_tpu.pipeline.fast_path import FusedPipeline
     from attendance_tpu.transport.memory_broker import (
@@ -115,7 +143,7 @@ def _oracle(seed: int) -> dict:
         Config(bloom_filter_capacity=50_000,
                transport_backend="memory"),
         client=client, num_banks=LECTURES)
-    roster, frames = _frames(seed)
+    roster, frames = _frames(seed, wire)
     frames = list(frames)
     pipe.preload(roster)
     producer = client.create_producer("attendance-events")
@@ -128,10 +156,13 @@ def _oracle(seed: int) -> dict:
 
 
 def run_soak(seed: int, *, spec: str = DEFAULT_SPEC, workdir,
-             max_seconds: float = 90.0) -> dict:
+             max_seconds: float = 90.0, wire: str = "binary") -> dict:
     """One seeded soak; returns the report dict (report["ok"] is the
     verdict). Resets the chaos/obs process globals around itself so
-    seeds run back to back in one process."""
+    seeds run back to back in one process. ``wire="columnar"`` ships
+    the SAME events as COLW compressed frames — the corrupt fault then
+    exercises the checksum-reject -> poison path end to end (loud DLQ,
+    never silent mutation; the oracle-equality gate IS the proof)."""
     from attendance_tpu import chaos, obs
 
     failures = []
@@ -143,7 +174,7 @@ def run_soak(seed: int, *, spec: str = DEFAULT_SPEC, workdir,
 
     chaos.disable()
     obs.disable()
-    want = _oracle(seed)
+    want = _oracle(seed, wire)
 
     work = Path(workdir) / f"seed-{seed}"
     work.mkdir(parents=True, exist_ok=True)
@@ -176,11 +207,11 @@ def run_soak(seed: int, *, spec: str = DEFAULT_SPEC, workdir,
     inj = chaos.ensure(config)
 
     pipe = FusedPipeline(config, num_banks=LECTURES)
-    roster, frames = _frames(seed)
+    roster, frames = _frames(seed, wire)
     frames = list(frames)
     pipe.preload(roster)
 
-    poisons = _poison_frames(seed)
+    poisons = _poison_frames(seed, wire)
     pub_client = make_client(config)  # chaos-wrapped: faults on publish
     producer = pub_client.create_producer(config.pulsar_topic)
     interval = max(1, len(frames) // (POISON_FRAMES + 1))
@@ -269,9 +300,9 @@ def run_soak(seed: int, *, spec: str = DEFAULT_SPEC, workdir,
         # At-least-once dead-lettering: a dead-letter ACK lost to an
         # injected reset redelivers the poison frame into one more
         # bounded cycle, so >= (duplicates share a digest).
-        check(pipe.metrics.dead_lettered >= POISON_FRAMES,
+        check(pipe.metrics.dead_lettered >= len(poisons),
               f"dead_lettered={pipe.metrics.dead_lettered}, "
-              f"expected >= {POISON_FRAMES}")
+              f"expected >= {len(poisons)}")
         # The quarantine holds poison frames as RECEIVED — a delivery
         # that also caught the in-flight ``corrupt`` fault lands as
         # its (deterministic, involutive) corrupted variant. Every
@@ -283,7 +314,7 @@ def run_soak(seed: int, *, spec: str = DEFAULT_SPEC, workdir,
             {hashlib.sha256(p).hexdigest(),
              hashlib.sha256(
                  ChaosInjector.corrupt_transform(p)).hexdigest()}
-            for p in _poison_frames(seed)]
+            for p in _poison_frames(seed, wire)]
         acceptable = set().union(*per_poison)
         got_digests = [e["sha256"] for e in entries]
         check(all(d in acceptable for d in got_digests),
@@ -359,6 +390,164 @@ def run_soak(seed: int, *, spec: str = DEFAULT_SPEC, workdir,
     return report
 
 
+def _shm_worker_main(args) -> None:
+    """The to-be-SIGKILLed half of the shm soak: consume the ring
+    with delta checkpointing until the parent kills us (or the stream
+    drains on the post-crash run)."""
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+
+    config = Config(
+        bloom_filter_capacity=50_000, ingress_wire="shm",
+        shm_dir=args.shm_dir, shm_slots=16, shm_slot_bytes=1 << 15,
+        snapshot_dir=args.snapshot_dir, snapshot_mode="delta",
+        snapshot_every_batches=4).validate()
+    roster, _ = _frames(args.seed)
+    pipe = FusedPipeline(config, num_banks=LECTURES)
+    pipe.preload(roster)
+    print("worker ready", flush=True)
+    pipe.run(idle_timeout_s=60.0)
+
+
+def run_shm_soak(seed: int, *, workdir,
+                 max_seconds: float = 120.0) -> dict:
+    """The shm-transport soak (ISSUE 11): a chaos-armed producer
+    (torn_slot + writer_stall at the ring's publish seam) feeds a
+    consumer SUBPROCESS that is SIGKILLed mid-ring once its snapshot
+    chain holds a delta; recovery restores the chain and resumes from
+    the ring's durable cursor — the unacked tail redelivers, and the
+    final state must equal the no-fault oracle exactly (the PR 4/5
+    group-commit + resume contracts, with the mmap ring as the wire)."""
+    import json as _json
+    import signal
+    import subprocess
+
+    from attendance_tpu import chaos, obs
+
+    failures = []
+    t_start = time.monotonic()
+
+    def check(cond, label):
+        if not cond:
+            failures.append(label)
+
+    chaos.disable()
+    obs.disable()
+    want = _oracle(seed)
+
+    work = Path(workdir) / f"shm-seed-{seed}"
+    work.mkdir(parents=True, exist_ok=True)
+    shm_dir = work / "rings"
+    snap = work / "snaps"
+
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.transport.shm_ring import ShmClient
+
+    config = Config(
+        bloom_filter_capacity=50_000, ingress_wire="shm",
+        shm_dir=str(shm_dir), shm_slots=16, shm_slot_bytes=1 << 15,
+        snapshot_dir=str(snap), snapshot_mode="delta",
+        snapshot_every_batches=4,
+        chaos=SHM_SPEC, chaos_seed=seed).validate()
+    inj = chaos.ensure(config)
+
+    roster, frames = _frames(seed)
+    frames = list(frames)
+    producer = ShmClient.from_config(config).create_producer(
+        config.pulsar_topic)
+
+    worker = subprocess.Popen(
+        [sys.executable, str(Path(__file__).resolve()), "--shm-worker",
+         "--shm-dir", str(shm_dir), "--snapshot-dir", str(snap),
+         "--seed", str(seed)],
+        stdout=subprocess.PIPE, text=True, cwd=str(REPO))
+    report = {"seed": seed, "spec": SHM_SPEC, "oracle": want}
+    try:
+        check(worker.stdout.readline().strip() == "worker ready",
+              "shm worker failed to start")
+
+        # Publish with the fault plane armed; the ring's backpressure
+        # paces us against the consumer (and stalls entirely while it
+        # is dead — bounded by the send timeout).
+        pub_done = threading.Event()
+        pub_errors = []
+
+        def publish():
+            try:
+                for f in frames:
+                    producer.send(f, timeout_s=max_seconds)
+            except BaseException as exc:  # noqa: BLE001
+                pub_errors.append(exc)
+            finally:
+                pub_done.set()
+
+        threading.Thread(target=publish, daemon=True).start()
+
+        # SIGKILL the consumer the moment its chain holds a delta —
+        # mid-ring by construction (acks lag the barriers).
+        chain_path = snap / "CHAIN.json"
+        deadline = time.monotonic() + max_seconds
+        while time.monotonic() < deadline:
+            try:
+                if _json.loads(chain_path.read_text()).get("deltas"):
+                    break
+            except (FileNotFoundError, ValueError):
+                pass
+            if worker.poll() is not None:
+                check(False, "shm worker exited before the kill")
+                return dict(report, failures=failures, ok=False,
+                            wall_s=round(time.monotonic() - t_start, 1))
+            time.sleep(0.02)
+        else:
+            check(False, "no delta snapshot within the deadline")
+            return dict(report, failures=failures, ok=False,
+                        wall_s=round(time.monotonic() - t_start, 1))
+        worker.send_signal(signal.SIGKILL)
+        worker.wait()
+
+        # Resume IN PROCESS: restore the chain, re-attach the ring —
+        # the durable cursor redelivers exactly the unacked tail.
+        from attendance_tpu.transport.shm_ring import ring_path
+        ring = ring_path(shm_dir, config.pulsar_topic, 0)
+        check(ring.exists(), "ring file vanished")
+        pipe = FusedPipeline(config, num_banks=LECTURES)
+        backlog = pipe.consumer.backlog() if not hasattr(
+            pipe.consumer, "lanes") else None
+        report["resume_backlog"] = backlog
+        check(backlog is None or backlog > 0,
+              "no unacked tail to redeliver (kill landed post-drain; "
+              "timing gate mis-set)")
+        pipe.run(idle_timeout_s=3.0)
+        check(pub_done.wait(timeout=max_seconds),
+              "publisher never finished (ring stuck full)")
+        check(not pub_errors, f"publisher raised: {pub_errors!r}")
+        pipe.run(idle_timeout_s=2.0)  # drain anything late
+        got = _state(pipe)
+        report["chaos_state"] = got
+        pipe.cleanup()
+        check(got == want,
+              f"shm crash+resume diverged from oracle: {got} != {want}")
+
+        injected = {f"{site}/{fault}": n
+                    for (site, fault), n in sorted(inj.injected.items())}
+        report["injected"] = injected
+        check(inj.injected_total("torn_slot") > 0,
+              "torn_slot armed but never fired")
+        check(inj.injected_total("writer_stall") > 0,
+              "writer_stall armed but never fired")
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.wait()
+        chaos.disable()
+        obs.disable()
+    report["wall_s"] = round(time.monotonic() - t_start, 1)
+    report["failures"] = failures
+    report["ok"] = not failures
+    return report
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, action="append", default=None,
@@ -366,20 +555,58 @@ def main() -> int:
     ap.add_argument("--spec", default=DEFAULT_SPEC,
                     help="chaos spec for the fault run ('rot' = the "
                     "storage-rot + partition spec: disk_corrupt/"
-                    "torn_write/enospc/partition with scrub gates)")
+                    "torn_write/enospc/partition with scrub gates; "
+                    "'shm' = the shared-memory ring soak: torn_slot/"
+                    "writer_stall + a real SIGKILL of the ring "
+                    "consumer and cursor-resume recovery)")
+    ap.add_argument("--wire", choices=["binary", "columnar"],
+                    default="binary",
+                    help="event wire for the fault run: columnar "
+                    "ships the same events as COLW compressed frames "
+                    "(checksum-reject -> loud DLQ under corrupt)")
     ap.add_argument("--workdir", default="/tmp/chaos_soak")
     ap.add_argument("--max-seconds", type=float, default=90.0,
                     help="per-seed deadline (termination invariant)")
+    ap.add_argument("--shm-worker", action="store_true",
+                    help=argparse.SUPPRESS)  # subprocess entry
+    ap.add_argument("--shm-dir", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--snapshot-dir", default="",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.shm_worker:
+        args.seed = (args.seed or [1])[0]
+        _shm_worker_main(args)
+        return 0
     if args.spec == "rot":
         args.spec = ROT_SPEC
     seeds = args.seed or [1]
     rc = 0
     for seed in seeds:
-        print(f"=== chaos soak seed={seed} spec={args.spec!r}",
-              flush=True)
+        if args.spec == "shm":
+            print(f"=== shm chaos soak seed={seed}", flush=True)
+            report = run_shm_soak(seed, workdir=args.workdir,
+                                  max_seconds=max(args.max_seconds,
+                                                  120.0))
+            summary = {k: v for k, v in report.items()
+                       if k not in ("failures", "oracle",
+                                    "chaos_state")}
+            print(f"seed {seed}: {summary}", flush=True)
+            if not report["ok"]:
+                rc = 1
+                for f in report["failures"]:
+                    print(f"FAIL seed={seed}: {f}", flush=True)
+                print("SOAK FAIL — replay with:\n  JAX_PLATFORMS=cpu "
+                      f"python tools/chaos_soak.py --seed {seed} "
+                      "--spec shm", flush=True)
+            else:
+                print(f"PASS seed={seed} ({report['wall_s']}s)",
+                      flush=True)
+            continue
+        print(f"=== chaos soak seed={seed} spec={args.spec!r} "
+              f"wire={args.wire}", flush=True)
         report = run_soak(seed, spec=args.spec, workdir=args.workdir,
-                          max_seconds=args.max_seconds)
+                          max_seconds=args.max_seconds,
+                          wire=args.wire)
         summary = {k: v for k, v in report.items()
                    if k not in ("failures", "oracle", "chaos_state")}
         print(f"seed {seed}: {summary}", flush=True)
