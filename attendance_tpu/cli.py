@@ -31,6 +31,14 @@ def _add_generate_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--throttle-s", type=float, default=0.0,
                    help="per-record sleep (reference behavior: 0.1-0.5)")
+    p.add_argument("--disorder-frac", type=float, default=0.0,
+                   help="fraction of events emitted out of order "
+                   "(arrival delayed by up to --late-max-s of event "
+                   "time; deterministic per --seed) — exercises the "
+                   "temporal plane's watermark/reorder stage")
+    p.add_argument("--late-max-s", type=float, default=0.0,
+                   help="max event-time lateness for --disorder-frac "
+                   "events (seconds)")
 
 
 def cmd_generate(args) -> None:
@@ -47,7 +55,8 @@ def cmd_generate(args) -> None:
         producer=producer, sketch_store=sketch,
         bloom_key=config.bloom_filter_key,
         num_students=args.num_students, num_invalid=args.num_invalid,
-        seed=args.seed, throttle_s=args.throttle_s, keep_events=False)
+        seed=args.seed, throttle_s=args.throttle_s, keep_events=False,
+        disorder_frac=args.disorder_frac, late_max_s=args.late_max_s)
     logger.info("Generated %d messages (%d invalid attempts)",
                 report.message_count, report.invalid_attempts)
     client.close()
@@ -294,7 +303,8 @@ def cmd_pipeline(args) -> None:
         producer=producer, sketch_store=processor.sketch,
         bloom_key=config.bloom_filter_key,
         num_students=args.num_students, num_invalid=args.num_invalid,
-        seed=args.seed, keep_events=False)
+        seed=args.seed, keep_events=False,
+        disorder_frac=args.disorder_frac, late_max_s=args.late_max_s)
     processor.process_attendance(max_events=report.message_count,
                                  idle_timeout_s=1.0)
     m = processor.metrics
@@ -685,7 +695,8 @@ def cmd_doctor(args) -> None:
                 lane_skew_ceiling=args.lane_skew_ceiling,
                 query_p99_ceiling=args.query_p99_ceiling,
                 staleness_ceiling=args.staleness_ceiling,
-                merge_lag_ceiling=args.merge_lag_ceiling)
+                merge_lag_ceiling=args.merge_lag_ceiling,
+                watermark_lag_ceiling=args.watermark_lag_ceiling)
         except FileNotFoundError as e:
             logger.error("no such fleet artifact dir: %s", e)
             sys.exit(2)
@@ -729,6 +740,7 @@ def cmd_doctor(args) -> None:
             query_p99_ceiling=args.query_p99_ceiling,
             staleness_ceiling=args.staleness_ceiling,
             merge_lag_ceiling=args.merge_lag_ceiling,
+            watermark_lag_ceiling=args.watermark_lag_ceiling,
             quarantine_dir=args.quarantine)
     except FileNotFoundError as e:
         logger.error("no such artifact: %s", e)
@@ -968,6 +980,14 @@ def main(argv=None) -> None:
                        help="gate attendance_read_staleness_seconds "
                        "(the published read epoch's age at the last "
                        "scrape); omitted = informational row")
+    p_doc.add_argument("--watermark-lag-ceiling-s", type=float,
+                       default=None, dest="watermark_lag_ceiling",
+                       help="gate attendance_watermark_lag_seconds "
+                       "(event-time lag between the stream head and "
+                       "the temporal watermark); omitted = "
+                       "informational row. Set only for runs that "
+                       "ran the temporal plane — an absent gauge "
+                       "fails loudly, never vacuously")
     p_doc.add_argument("--merge-lag-ceiling", type=float, default=None,
                        help="gate the federation merge-lag p99 "
                        "(fence -> folded-into-global-view seconds) "

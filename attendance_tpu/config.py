@@ -325,6 +325,34 @@ class Config:
     # lecture day — unbounded on a long multi-day run without a cap.
     # <= 0 disables the guard.
     metric_series_max: int = 1024
+    # Temporal sketch plane (attendance_tpu/temporal): when
+    # temporal_period_s > 0 the fused pipeline grows a windowed-HLL
+    # bucket ring — one HLL bank row per (lecture day, time period)
+    # bucket, living in the SAME register array / bank_of map /
+    # delta-snapshot chain as the per-day banks — plus a watermarked
+    # reorder stage at the codec seam and a Count-Min + top-K
+    # gate-fraud kernel (models/cms.py). Window queries
+    # (window_pfcount / window_occupancy / rate_series) serve
+    # merge-on-read from the epoch mirror. Single-chip only: the
+    # sharded engine has no bank-recycle path yet (validated below).
+    temporal_period_s: float = 0.0
+    # Event-time lateness budget: the watermark trails the stream
+    # head by this much, out-of-order events within it land in their
+    # correct still-open bucket, and events behind a rotated bucket
+    # are counted + side-channeled instead of misbucketed.
+    allowed_lateness_s: float = 5.0
+    # Wall-clock silence after which the watermark advances to the
+    # stream head (releasing the reorder buffer and letting final
+    # buckets rotate). 0 = only end-of-run flushes.
+    watermark_idle_s: float = 2.0
+    # Bucket rows the temporal ring retains (open + queryable-closed);
+    # ring pressure evicts the oldest CLOSED bucket, zeroing and
+    # recycling its bank row. Open buckets are never evicted.
+    temporal_ring_banks: int = 256
+    # Count-Min geometry + heavy-hitter set size for the fraud kernel.
+    cms_depth: int = 4
+    cms_width: int = 1 << 14
+    cms_topk: int = 16
     # Storage-integrity plane (utils/integrity): when on (the
     # default), every durable chain artifact's payload digest is
     # recorded in its manifest (CHAIN.json base_digest/digests,
@@ -459,6 +487,29 @@ class Config:
         if self.persist_breaker_cooldown_s <= 0:
             raise ValueError(
                 "persist_breaker_cooldown_s must be positive")
+        if self.temporal_period_s < 0:
+            raise ValueError("temporal_period_s must be >= 0 (0 = off)")
+        if self.temporal_period_s:
+            from attendance_tpu.temporal.buckets import period_micros
+            period_micros(self.temporal_period_s)  # >= 1s, loud
+            if self.num_shards * self.num_replicas > 1:
+                raise ValueError(
+                    "the temporal plane is single-chip only (the "
+                    "sharded engine has no bank-recycle path): unset "
+                    "--temporal-period-s or run 1 shard x 1 replica")
+        if self.allowed_lateness_s < 0:
+            raise ValueError("allowed_lateness_s must be >= 0")
+        if self.watermark_idle_s < 0:
+            raise ValueError(
+                "watermark_idle_s must be >= 0 (0 = only end-of-run "
+                "flushes advance an idle watermark)")
+        if self.temporal_ring_banks < 2:
+            raise ValueError("temporal_ring_banks must be >= 2")
+        if self.cms_depth < 1 or self.cms_width < 1:
+            raise ValueError(
+                f"bad CMS geometry {self.cms_depth}x{self.cms_width}")
+        if self.cms_topk < 1:
+            raise ValueError("cms_topk must be >= 1")
         if self.invalid_topic and self.invalid_topic == self.pulsar_topic:
             # Republishing invalid events onto the processor's own
             # input topic would re-consume and republish them forever.
@@ -650,6 +701,33 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    help="label-cardinality cap per metric name "
                    "(<= 0 = unlimited); overflow folds into an "
                    "unexported sink and logs once at ERROR")
+    p.add_argument("--temporal-period-s", type=float,
+                   default=d.temporal_period_s,
+                   help="bucket width of the temporal sketch plane in "
+                   "seconds (>= 1; 0 = temporal plane off): windowed "
+                   "HLL banks per (lecture day, period), watermarked "
+                   "reorder, CMS gate-fraud kernel")
+    p.add_argument("--allowed-lateness", type=float,
+                   default=d.allowed_lateness_s, metavar="SECONDS",
+                   dest="allowed_lateness",
+                   help="event-time lateness budget: the watermark "
+                   "trails the stream head by this much; later events "
+                   "fold into still-open buckets or side-channel")
+    p.add_argument("--watermark-idle-s", type=float,
+                   default=d.watermark_idle_s,
+                   help="wall-clock silence after which the watermark "
+                   "advances to the stream head (0 = only end-of-run)")
+    p.add_argument("--temporal-ring-banks", type=int,
+                   default=d.temporal_ring_banks,
+                   help="bucket rows the temporal ring retains; "
+                   "pressure evicts the oldest CLOSED bucket")
+    p.add_argument("--cms-depth", type=int, default=d.cms_depth,
+                   help="Count-Min rows (fraud kernel)")
+    p.add_argument("--cms-width", type=int, default=d.cms_width,
+                   help="Count-Min buckets per row")
+    p.add_argument("--cms-topk", type=int, default=d.cms_topk,
+                   help="heavy-hitter candidates tracked by the "
+                   "fraud kernel")
     p.add_argument("--no-integrity", action="store_true",
                    help="skip payload-digest computation at the "
                    "durable writers (bench baseline; verification "
@@ -765,6 +843,13 @@ def config_from_args(args: argparse.Namespace) -> Config:
         fleet_port=args.fleet_port,
         fleet_dir=args.fleet_dir,
         metric_series_max=args.metric_series_max,
+        temporal_period_s=args.temporal_period_s,
+        allowed_lateness_s=args.allowed_lateness,
+        watermark_idle_s=args.watermark_idle_s,
+        temporal_ring_banks=args.temporal_ring_banks,
+        cms_depth=args.cms_depth,
+        cms_width=args.cms_width,
+        cms_topk=args.cms_topk,
         integrity=not args.no_integrity,
         retry_budget_s=args.retry_budget_s,
         serve_port=args.serve_port,

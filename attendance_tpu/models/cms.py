@@ -1,0 +1,243 @@
+"""Count-Min sketch + top-K heavy hitters — the second fused sketch.
+
+The Bloom+HLL pair answers "is this key a member" and "how many
+distinct keys"; gate-fraud detection needs the third sketch question:
+"how OFTEN does each key swipe" under a bounded memory budget. A
+Count-Min sketch answers point-frequency queries with a one-sided
+error (estimates never undercount; overcount bounded by
+``e * total / width`` with probability ``1 - e^-depth``), which is
+exactly the fraud shape: a hot card/gate can hide its count from an
+exact dict only by exhausting memory, but can never hide from CMS.
+
+Same banked-device-array discipline as models/bloom + models/hll:
+
+  * state is ONE device array ``uint32[depth, width]``; a whole
+    micro-batch of increments is a single scatter-add (XLA sums
+    duplicate indices, so per-batch multiplicity is exact);
+  * hash lanes are murmur3_u32 with per-row derived seeds — the same
+    vectorized hash layer the Bloom/HLL kernels ride, and the numpy
+    twin (``*_np``) is bit-identical so the read path never touches
+    the device;
+  * the fused step (:func:`cms_step`) updates AND answers in one
+    dispatch: the returned estimates flow back as a lazy device array
+    exactly like the fused pipeline's validity vector, so the hot
+    loop never synchronizes — the temporal plane folds them into its
+    top-K candidate heap at rotation boundaries.
+
+Unlike Bloom/HLL the CMS is NOT idempotent under replay (counts are
+sums), so it is deliberately excluded from the snapshot/ack
+durability contract: it is an advisory detector whose state resets on
+restore, documented in the temporal plane. The durable windowed
+counts stay in the HLL bank plane.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from attendance_tpu.ops.murmur3 import murmur3_u32, murmur3_u32_np
+
+# Base seed of the CMS hash-lane family, well separated from the
+# Bloom/HLL seeds in ops/murmur3; row r hashes with SEED_CMS_BASE
+# advanced by r golden-ratio steps (odd constant -> distinct lanes).
+SEED_CMS_BASE = np.uint32(0x7F4A7C15)
+_SEED_STEP = np.uint32(0x9E3779B9)
+
+DEFAULT_DEPTH = 4
+DEFAULT_WIDTH = 1 << 14
+
+
+def row_seed(row: int) -> np.uint32:
+    return np.uint32((int(SEED_CMS_BASE) + row * int(_SEED_STEP))
+                     & 0xFFFFFFFF)
+
+
+def cms_init(depth: int = DEFAULT_DEPTH,
+             width: int = DEFAULT_WIDTH) -> jax.Array:
+    """Fresh all-zero counts: uint32[depth, width]."""
+    if depth < 1 or width < 1:
+        raise ValueError(f"bad CMS geometry {depth}x{width}")
+    return jnp.zeros((depth, width), dtype=jnp.uint32)
+
+
+def cms_positions(keys: jax.Array, depth: int, width: int) -> jax.Array:
+    """Per-key bucket per row: int32[depth, B] (device)."""
+    keys = jnp.asarray(keys).astype(jnp.uint32)
+    rows = []
+    for r in range(depth):
+        h = murmur3_u32(keys, row_seed(r))
+        rows.append((h % jnp.uint32(width)).astype(jnp.int32))
+    return jnp.stack(rows)
+
+
+def cms_positions_np(keys: np.ndarray, depth: int,
+                     width: int) -> np.ndarray:
+    """Numpy mirror of :func:`cms_positions` — bit-identical buckets
+    (same murmur3 lanes), backing the host read path and the
+    differential tests."""
+    with np.errstate(over="ignore"):
+        keys = np.asarray(keys).astype(np.uint32)
+        rows = [
+            (murmur3_u32_np(keys, row_seed(r)) % np.uint32(width))
+            .astype(np.int64)
+            for r in range(depth)]
+    return np.stack(rows)
+
+
+def cms_update(counts: jax.Array, keys: jax.Array,
+               mask: Optional[jax.Array] = None) -> jax.Array:
+    """Batched increment: +1 per (row, key bucket). Duplicate keys in
+    a batch each count (scatter-add sums colliding indices); masked
+    lanes scatter out of bounds and are dropped."""
+    depth, width = counts.shape
+    pos = cms_positions(keys, depth, width)  # [depth, B]
+    row_off = jnp.arange(depth, dtype=jnp.int32)[:, None] * width
+    flat = pos + row_off
+    if mask is not None:
+        flat = jnp.where(mask[None, :], flat, depth * width)  # OOB drop
+    out = counts.reshape(-1).at[flat.reshape(-1)].add(
+        jnp.uint32(1), mode="drop")
+    return out.reshape(depth, width)
+
+
+def cms_query(counts: jax.Array, keys: jax.Array) -> jax.Array:
+    """Point-frequency estimates: uint32[B] = min over rows of the
+    gathered buckets (the classic one-sided CMS estimate)."""
+    depth, width = counts.shape
+    pos = cms_positions(keys, depth, width)
+    gathered = jnp.stack([counts[r, pos[r]] for r in range(depth)])
+    return jnp.min(gathered, axis=0)
+
+
+def cms_step(counts: jax.Array, keys: jax.Array,
+             mask: Optional[jax.Array] = None
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Fused update-then-query in ONE dispatch: returns
+    ``(new_counts, est uint32[B])`` where est is each key's estimate
+    AFTER this batch folded in (masked lanes read 0). The estimate
+    array is the lazy handle the temporal plane stages for its top-K
+    fold — same discipline as the fused pipeline's validity vector."""
+    out = cms_update(counts, keys, mask)
+    est = cms_query(out, keys)
+    if mask is not None:
+        est = jnp.where(mask, est, jnp.uint32(0))
+    return out, est
+
+
+def make_jitted_cms_step(donate: bool = True):
+    """jit of :func:`cms_step` (one compile per batch shape; counts
+    donated so HBM updates in place)."""
+    return jax.jit(cms_step, donate_argnums=(0,) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# Numpy twin (read path / oracle)
+# ---------------------------------------------------------------------------
+
+def cms_init_np(depth: int = DEFAULT_DEPTH,
+                width: int = DEFAULT_WIDTH) -> np.ndarray:
+    return np.zeros((depth, width), dtype=np.uint32)
+
+
+def cms_update_np(counts: np.ndarray, keys: np.ndarray,
+                  mask: Optional[np.ndarray] = None) -> np.ndarray:
+    """Host twin of :func:`cms_update` (in place; returns counts)."""
+    depth, width = counts.shape
+    keys = np.asarray(keys)
+    if mask is not None:
+        keys = keys[np.asarray(mask, bool)]
+    if len(keys) == 0:
+        return counts
+    pos = cms_positions_np(keys, depth, width)
+    for r in range(depth):
+        np.add.at(counts[r], pos[r], np.uint32(1))
+    return counts
+
+
+def cms_query_np(counts: np.ndarray, keys: np.ndarray) -> np.ndarray:
+    """Host twin of :func:`cms_query`: uint32[B] min-over-rows."""
+    depth, width = counts.shape
+    keys = np.asarray(keys)
+    if len(keys) == 0:
+        return np.zeros(0, np.uint32)
+    pos = cms_positions_np(keys, depth, width)
+    gathered = np.stack([counts[r][pos[r]] for r in range(depth)])
+    return np.min(gathered, axis=0)
+
+
+class TopK:
+    """Bounded heavy-hitter candidate set over CMS estimates.
+
+    The classic CMS+heap pattern: every observed (key, estimate) pair
+    is offered; keys keep their LARGEST estimate seen (estimates are
+    monotone in stream position, so the last sighting carries the
+    best total); the set trims to the K largest. A true heavy hitter
+    is present in every batch that contains it, so it can never be
+    evicted for good — the zero-miss property the fraud gate asserts.
+    """
+
+    def __init__(self, k: int):
+        if k < 1:
+            raise ValueError("top-K needs k >= 1")
+        self.k = k
+        self._best: dict = {}
+        # Admission threshold: once K candidates exist, a key must
+        # estimate ABOVE the current K-th best to enter — the whole
+        # batch pre-filters against it vectorized, so the per-key
+        # Python fold only ever sees plausible heavy hitters (a
+        # threshold-free fold over every distinct key per block was
+        # the temporal plane's measured hot spot). Monotone estimates
+        # keep this lossless for true heavy hitters: their running
+        # estimate crosses any K-th-best bar they belong above.
+        self._thresh = 0
+
+    def offer(self, keys: np.ndarray, ests: np.ndarray) -> None:
+        """Fold a batch of (key, estimate) pairs (vectorized
+        threshold filter + one groupby-max pass per distinct
+        surviving key)."""
+        keys = np.asarray(keys, np.uint32)
+        ests = np.asarray(ests, np.uint64)
+        if len(keys) == 0:
+            return
+        if self._thresh:
+            m = ests > np.uint64(self._thresh)
+            keys, ests = keys[m], ests[m]
+            if len(keys) == 0:
+                return
+        order = np.argsort(keys, kind="stable")
+        sk, se = keys[order], ests[order]
+        starts = np.concatenate([[True], sk[1:] != sk[:-1]])
+        idx = np.flatnonzero(starts)
+        grouped = np.maximum.reduceat(se, idx)
+        if len(idx) > 8 * self.k:
+            # Bound the Python fold: only the batch's own top slice
+            # can displace anything in a K-bounded set.
+            top = np.argpartition(grouped, -8 * self.k)[-8 * self.k:]
+            sk_idx, grouped = sk[idx][top], grouped[top]
+        else:
+            sk_idx = sk[idx]
+        best = self._best
+        for key, est in zip(sk_idx.tolist(), grouped.tolist()):
+            prev = best.get(key)
+            if prev is None or est > prev:
+                best[key] = est
+        if len(best) > 4 * self.k:
+            self._trim()
+
+    def _trim(self) -> None:
+        keep = sorted(self._best.items(), key=lambda kv: -kv[1])[:self.k]
+        self._best = dict(keep)
+        if len(keep) >= self.k:
+            self._thresh = keep[-1][1]
+
+    def items(self):
+        """[(key, estimate)] sorted hottest first, trimmed to K."""
+        self._trim()
+        return sorted(self._best.items(), key=lambda kv: -kv[1])
+
+    def __len__(self) -> int:
+        return min(len(self._best), self.k)

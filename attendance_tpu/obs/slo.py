@@ -134,6 +134,11 @@ def parse_slo(spec: str) -> Slo:
         # epoch's age (bounded by the snapshot barrier cadence).
         return Slo("read_staleness", "gauge",
                    "attendance_read_staleness_seconds", op, threshold)
+    if alias == "watermark_lag":
+        # The temporal plane's freshness objective: how far the
+        # watermark trails the stream head (event-time seconds).
+        return Slo("watermark_lag", "gauge",
+                   "attendance_watermark_lag_seconds", op, threshold)
     if alias == "snapshot_failures":
         # The PR-robustness hook: a bounded-backoff writer retrying a
         # failing disk is healthy; an unbounded failure COUNT is not.
@@ -478,7 +483,8 @@ def _prom_checks(text: str, fpr_ceiling: float,
                  lane_skew_ceiling: Optional[float] = None,
                  query_p99_ceiling: Optional[float] = None,
                  staleness_ceiling: Optional[float] = None,
-                 merge_lag_ceiling: Optional[float] = None
+                 merge_lag_ceiling: Optional[float] = None,
+                 watermark_lag_ceiling: Optional[float] = None
                  ) -> List[List[str]]:
     from attendance_tpu.obs.exposition import parse_prom
 
@@ -667,6 +673,43 @@ def _prom_checks(text: str, fpr_ceiling: float,
     if chain:
         rows.append(["snapshot chain length", _fmt_value(max(chain)),
                      "-", "info"])
+    # Temporal plane: watermark lag (gated by
+    # --watermark-lag-ceiling-s; informational without), late-event
+    # outcomes and bucket-rotation totals (always informational — a
+    # dropped straggler is a data-quality fact the side channel
+    # already preserved, not an SLO breach).
+    wlag = _vals("attendance_watermark_lag_seconds")
+    if wlag or watermark_lag_ceiling is not None:
+        worst = max(wlag) if wlag else None
+        if watermark_lag_ceiling is None:
+            rows.append(["watermark lag", _fmt_value(worst), "-",
+                         "info"])
+        else:
+            # Like the merge-lag gate: a ceiling set for a run that
+            # never ran the temporal plane must FAIL loudly, not pass
+            # vacuously.
+            rows.append(["watermark lag", _fmt_value(worst),
+                         f"<= {_fmt_value(watermark_lag_ceiling)}",
+                         "FAIL" if worst is None
+                         or worst > watermark_lag_ceiling else "PASS"])
+    late_folded = _vals("attendance_late_events_total",
+                        'outcome="folded"')
+    if late_folded and max(late_folded) > 0:
+        rows.append(["late events folded (still-open bucket)",
+                     _fmt_value(max(late_folded)), "-", "info"])
+    late_dropped = _vals("attendance_late_events_total",
+                         'outcome="dropped"')
+    if late_dropped and max(late_dropped) > 0:
+        rows.append(["late events dropped (side channel)",
+                     _fmt_value(max(late_dropped)), "-", "info"])
+    rotations = _vals("attendance_window_rotations_total")
+    if rotations:
+        rows.append(["window bucket rotations",
+                     _fmt_value(max(rotations)), "-", "info"])
+    evictions = _vals("attendance_window_evictions_total")
+    if evictions and max(evictions) > 0:
+        rows.append(["window buckets evicted (ring pressure)",
+                     _fmt_value(max(evictions)), "-", "info"])
     # Self-healing transport: reconnects are REMEDIATION (each one is
     # a survived outage), so the row is informational by default —
     # --max-reconnects turns it into a gate for runs that should have
@@ -805,7 +848,8 @@ def _quarantine_rows(directory: str) -> List[List[str]]:
 
 def _fleet_wide_rows(per_role_samples: Dict[str, list],
                      merge_lag_ceiling: Optional[float],
-                     staleness_ceiling: Optional[float]
+                     staleness_ceiling: Optional[float],
+                     watermark_lag_ceiling: Optional[float] = None
                      ) -> List[List[str]]:
     """Fleet-level rows judged over the MERGED data: merge-lag p99
     from the summed cumulative buckets across every artifact that has
@@ -858,6 +902,30 @@ def _fleet_wide_rows(per_role_samples: Dict[str, list],
                          "n/a" if worst is None
                          else ("PASS" if worst <= staleness_ceiling
                                else "FAIL")])
+    # Temporal plane: worst watermark lag across every role that
+    # exports the gauge — informational when present without a
+    # ceiling (like staleness/merge-lag above); a ceiling over a
+    # fleet with NO temporal role fails loudly, never vacuously.
+    lags = []
+    for samples in per_role_samples.values():
+        for name, _labels, v in samples:
+            if name != "attendance_watermark_lag_seconds":
+                continue
+            try:
+                v = float(v)
+            except ValueError:
+                continue
+            if not math.isnan(v):
+                lags.append(v)
+    if watermark_lag_ceiling is not None:
+        worst = max(lags) if lags else None
+        rows.append(["fleet: worst watermark lag", _fmt_value(worst),
+                     f"<= {_fmt_value(watermark_lag_ceiling)}",
+                     "FAIL" if worst is None
+                     or worst > watermark_lag_ceiling else "PASS"])
+    elif lags:
+        rows.append(["fleet: worst watermark lag",
+                     _fmt_value(max(lags)), "-", "info"])
     rows.append(["fleet: SLO alerts firing across roles",
                  str(firing), "== 0",
                  "PASS" if firing == 0 else "FAIL"])
@@ -873,7 +941,8 @@ def doctor_fleet_report(fleet_dir: str, *,
                         lane_skew_ceiling: Optional[float] = None,
                         query_p99_ceiling: Optional[float] = None,
                         staleness_ceiling: Optional[float] = None,
-                        merge_lag_ceiling: Optional[float] = None
+                        merge_lag_ceiling: Optional[float] = None,
+                        watermark_lag_ceiling: Optional[float] = None
                         ) -> Tuple[str, bool]:
     """ONE verdict table over a fleet collector's artifact directory
     (``--fleet-dir``): every ``<role>@<instance>.prom`` the collector
@@ -907,7 +976,8 @@ def doctor_fleet_report(fleet_dir: str, *,
                                 merge_lag_ceiling=None):
             rows.append([f"{role}: {row[0]}", *row[1:]])
     rows.extend(_fleet_wide_rows(per_role_samples, merge_lag_ceiling,
-                                 staleness_ceiling))
+                                 staleness_ceiling,
+                                 watermark_lag_ceiling))
     trace_path = root / "fleet_trace.json"
     if trace_path.exists():
         doc = json.loads(trace_path.read_text())
@@ -939,6 +1009,7 @@ def doctor_report(paths: Sequence[str], *,
                   query_p99_ceiling: Optional[float] = None,
                   staleness_ceiling: Optional[float] = None,
                   merge_lag_ceiling: Optional[float] = None,
+                  watermark_lag_ceiling: Optional[float] = None,
                   quarantine_dir: str = ""
                   ) -> Tuple[str, bool]:
     """Replay run artifacts offline; returns (verdict text, ok).
@@ -969,7 +1040,8 @@ def doctor_report(paths: Sequence[str], *,
                                      lane_skew_ceiling,
                                      query_p99_ceiling,
                                      staleness_ceiling,
-                                     merge_lag_ceiling))
+                                     merge_lag_ceiling,
+                                     watermark_lag_ceiling))
         elif kind == "alerts":
             arows, traces = _alert_checks(payload)
             rows.extend(arows)

@@ -527,7 +527,13 @@ class FusedPipeline:
         # bytes wire — without it only wire_dwell reveals the switch.
         self._warned_word_degrade = False
         self._profiling = bool(self.config.profile_dir)
+        # Bank allocation: days AND temporal buckets share one map and
+        # one register array. The allocator is a monotonic counter
+        # plus a free list — the temporal ring's evictions recycle
+        # bank rows, so "next bank = len(map)" stopped being sound.
         self._bank_of: Dict[int, int] = {}
+        self._next_bank = 0
+        self._free_banks: list = []
         # Dense day->bank lookup: maps days in [base, base + LUT) with one
         # O(n) fancy-index instead of an O(n log n) np.unique per batch.
         self._day_base: Optional[int] = None
@@ -631,6 +637,25 @@ class FusedPipeline:
                 self.config, client=self.client,
                 m_bits=self.params.m_bits, k=self.params.k,
                 obs=self._obs).start_heartbeat()
+        # Temporal sketch plane (attendance_tpu/temporal): windowed
+        # HLL bucket ring + watermarked reorder + CMS fraud kernel.
+        # Buckets are ordinary bank_of entries (synthetic keys), so
+        # the delta chain / epoch mirror / federation frames below
+        # carry them with no new machinery. Constructed BEFORE
+        # restore() so a restored chain re-seeds the ring. One
+        # `is not None` branch on the hot path when off.
+        self._temporal = None
+        if getattr(self.config, "temporal_period_s", 0.0) > 0:
+            from attendance_tpu.temporal.plane import TemporalPlane
+            self._temporal = TemporalPlane(
+                self.config,
+                alloc_bank=self._register_temporal_bucket,
+                free_buckets=self._free_temporal_buckets,
+                mark_dirty=self._mark_temporal_dirty,
+                dispatch_add=self._temporal_dispatch,
+                obs=self._obs)
+            self._t_add = None  # lazy jit (needs params at trace)
+            self._t_clear = None
         if self._snap_dir is not None:
             self.restore()
         # Accuracy auditor (obs/audit.py): the hot loop only RECORDS
@@ -699,6 +724,10 @@ class FusedPipeline:
             # the filter does not hold yet reads the whole roster as
             # false negatives (seen under chaos-soak timing).
             self._auditor.record_roster(keys)
+        if self._temporal is not None:
+            # The window shadow classifies validity by roster
+            # membership — same after-the-preload ordering note.
+            self._temporal.record_roster(keys)
         self._roster_size = len(keys)
         if not self.sharded and (self.checkpointing
                                  or self.query_engine is not None
@@ -760,14 +789,24 @@ class FusedPipeline:
                 self.params, np.dtype(new_dtype).itemsize,
                 self.config.hll_precision)
 
+    def _alloc_bank(self) -> int:
+        """Next free HLL bank row: the free list (rows recycled by
+        temporal-ring evictions) first, else the monotonic counter,
+        growing the register array on demand."""
+        if self._free_banks:
+            return self._free_banks.pop()
+        bank = self._next_bank
+        while bank >= self._num_banks():
+            # Double the bank array (rare; one recompile per size).
+            self._grow_banks()
+        self._next_bank = bank + 1
+        return bank
+
     def _register_day(self, day: int) -> int:
         bank = self._bank_of.get(day)
         if bank is not None:
             return bank
-        bank = len(self._bank_of)
-        if bank >= self._num_banks():
-            # Double the bank array (rare; one recompile per size).
-            self._grow_banks()
+        bank = self._alloc_bank()
         self._bank_of[day] = bank
         if self._day_base is not None:
             off = day - self._day_base
@@ -828,6 +867,101 @@ class FusedPipeline:
                                 for d in vals.tolist()]
             banks[misses] = fixed
         return banks.astype(np.int32, copy=False)
+
+    # -- temporal plane hooks ------------------------------------------------
+    def _register_temporal_bucket(self, key: int) -> int:
+        """Allocate one bank row for a temporal bucket key (the
+        BucketRing's alloc callback). Rides the same allocator as
+        days; the plane marks the key dirty on every frame that
+        touches it, so recycled rows re-persist through the chain."""
+        bank = self._alloc_bank()
+        self._bank_of[key] = bank
+        return bank
+
+    def _free_temporal_buckets(self, keys, banks) -> None:
+        """Evict rotated buckets: drop their keys from the bank map,
+        zero the device rows, and recycle the rows via the free list
+        (the BucketRing's eviction callback)."""
+        for key in keys:
+            self._bank_of.pop(key, None)
+            self._dirty_days.discard(key)
+        if self.sharded or not banks:
+            return
+        regs = self.state.hll_regs
+        if self._t_clear is None:
+            self._t_clear = jax.jit(
+                lambda r, idx: r.at[idx].set(jax.numpy.uint8(0),
+                                             mode="drop"),
+                donate_argnums=(0,))
+        padded = 8
+        while padded < len(banks):
+            padded *= 2
+        idx = np.full(padded, regs.shape[0], np.int32)  # OOB = no-op
+        idx[:len(banks)] = banks
+        self.state = self.state._replace(
+            hll_regs=self._t_clear(regs, jax.numpy.asarray(idx)))
+        self._free_banks.extend(int(b) for b in banks)
+
+    def _mark_temporal_dirty(self, keys) -> None:
+        if self._snap_dirty:
+            self._dirty_days.update(keys)
+
+    def _temporal_dispatch(self, keys: np.ndarray,
+                           banks: np.ndarray) -> None:
+        """One fused Bloom-probe + windowed hll_add dispatch into the
+        SHARED register array (bank -1 lanes drop). Joins the device
+        queue after the frame's main step, so the barrier capture of
+        dirty bucket rows orders after it — the PR 4 ack contract
+        extends to window contributions for free."""
+        if self._t_add is None:
+            from attendance_tpu.models.bloom import (
+                bloom_contains_words)
+            from attendance_tpu.models.hll import hll_add
+            params = self.params
+            prec = self.config.hll_precision
+
+            def _add(regs, words, ks, bs):
+                valid = bloom_contains_words(words, ks, params)
+                return hll_add(regs,
+                               jax.numpy.where(valid, bs, -1), ks,
+                               precision=prec)
+
+            self._t_add = jax.jit(_add, donate_argnums=(0,))
+        n = len(keys)
+        padded = 256
+        while padded < n:
+            padded *= 2
+        kbuf = np.zeros(padded, np.uint32)
+        kbuf[:n] = keys
+        bbuf = np.full(padded, -1, np.int32)
+        bbuf[:n] = banks
+        self.state = self.state._replace(hll_regs=self._t_add(
+            self.state.hll_regs, self.state.bloom_bits,
+            jax.numpy.asarray(kbuf), jax.numpy.asarray(bbuf)))
+
+    def temporal_stats(self) -> Optional[Dict]:
+        """The temporal plane's live counters (None when off)."""
+        return (self._temporal.stats() if self._temporal is not None
+                else None)
+
+    def window_counts(self) -> Dict[int, int]:
+        """PFCOUNT of every live temporal bucket in ONE device pass:
+        {bucket key: unique-valid-student estimate} — the write-side
+        twin of the query plane's window verbs (tests/soaks compare
+        the two)."""
+        from attendance_tpu.temporal.buckets import is_bucket_key
+        keys = {k: b for k, b in self._bank_of.items()
+                if is_bucket_key(k)}
+        if not keys:
+            return {}
+        if self.sharded:
+            ests = self.engine.count_all()
+            return {k: int(ests[b]) for k, b in keys.items()}
+        hists = np.asarray(best_histogram(self.state.hll_regs,
+                                          self.config.hll_precision))
+        return {k: int(round(estimate_from_histogram(
+            hists[b], self.config.hll_precision)))
+            for k, b in keys.items()}
 
     # -- hot loop -----------------------------------------------------------
     def process_frame(self, data: bytes):
@@ -919,6 +1053,11 @@ class FusedPipeline:
             # the zero-copy views; this copies only the narrow stored
             # columns, off the wire's critical path.)
             cols = {k: np.array(v) for k, v in cols.items()}
+        if self._temporal is not None:
+            # Temporal sidecar: windowed adds dispatch with this
+            # frame (order-free scatter-max, same ack barrier); the
+            # reorder stage feeds the order-sensitive consumers.
+            self._temporal.observe_frame(cols)
         self.store.insert_columns({**cols, "is_valid": stored})
         self.metrics.batches += 1
         self.metrics.events += n
@@ -2128,6 +2267,21 @@ class FusedPipeline:
         bank_of_raw = chain_state["bank_of"]
         events = chain_state["events"]
         applied = chain_state["applied"]
+        # Rebuild the bank allocator BEFORE pushing state to the
+        # device: holes left by temporal-ring evictions become the
+        # free list, and their restored rows must be ZEROED here — an
+        # evicted bucket's device row was zeroed live but its dirty
+        # mark was discarded with it, so the chain still holds the
+        # dead bucket's registers; re-allocating such a hole without
+        # this zero would scatter-max new keys onto stale state and
+        # overcount (caught by review; covered by
+        # test_restored_free_bank_reallocates_clean).
+        used = set(int(b) for b in bank_of_raw.values())
+        next_bank = (max(used) + 1) if used else 0
+        free_banks = sorted(set(range(next_bank)) - used)
+        if free_banks:
+            regs = np.array(regs, dtype=np.uint8)
+            regs[np.asarray(free_banks, np.int64)] = 0
         if self.sharded:
             self.engine.set_state(bits, regs)
             self.engine.set_counts(counts)
@@ -2148,6 +2302,10 @@ class FusedPipeline:
                     self.params, np.dtype(new_dtype).itemsize,
                     self.config.hll_precision)
         self._bank_of = {int(d): b for d, b in bank_of_raw.items()}
+        self._next_bank = next_bank
+        self._free_banks = free_banks
+        if self._temporal is not None:
+            self._temporal.restore(self._bank_of)
         self._day_base = None
         self._day_lut.fill(-1)
         self._bloom_host = np.asarray(bits)
@@ -2584,6 +2742,12 @@ class FusedPipeline:
             if self._obs is not None:
                 self._obs.dump_flight("run-loop-exception")
             raise
+        if self._temporal is not None:
+            # End of run: release the reorder buffer, rotate final
+            # buckets, fold the staged CMS estimates. Before the
+            # final barrier so a rotation's eviction bookkeeping
+            # lands in the last manifest.
+            self._temporal.flush()
         if self.checkpointing:
             if self._inflight:
                 self._checkpoint_and_ack()  # flushes the writer first
@@ -2640,6 +2804,11 @@ class FusedPipeline:
                     t_got = time.perf_counter()
                     self._h_dequeue.observe(t_got - t_rx)
             except ReceiveTimeout:
+                if self._temporal is not None:
+                    # Watermark idle advancement: a silent stream
+                    # must not pin the reorder buffer / final buckets
+                    # open forever (--watermark-idle-s).
+                    self._temporal.maybe_idle_flush()
                 if self.checkpointing and self._inflight:
                     self._checkpoint_and_ack()
                 self._drain_inflight(block=-1)
@@ -2700,8 +2869,11 @@ class FusedPipeline:
 
     # -- queries ------------------------------------------------------------
     def lecture_days(self):
-        """Sorted lecture days with an HLL bank (the countable keys)."""
-        return sorted(self._bank_of)
+        """Sorted lecture days with an HLL bank (the countable keys;
+        temporal bucket keys live in the same map but are served by
+        the window verbs, not the day surface)."""
+        from attendance_tpu.temporal.buckets import is_bucket_key
+        return sorted(d for d in self._bank_of if not is_bucket_key(d))
 
     def validity_counts(self) -> Optional[tuple]:
         """(valid, invalid) totals accumulated on device since
@@ -2776,17 +2948,19 @@ class FusedPipeline:
         (one histogram over all banks instead of a dispatch per day) —
         the batch counterpart of :meth:`count`, matching the sharded
         engine's count_all."""
-        if not self._bank_of:
+        from attendance_tpu.temporal.buckets import is_bucket_key
+        days = {d: b for d, b in self._bank_of.items()
+                if not is_bucket_key(d)}
+        if not days:
             return {}
         if self.sharded:
             ests = self.engine.count_all()
-            return {day: int(ests[bank])
-                    for day, bank in self._bank_of.items()}
+            return {day: int(ests[bank]) for day, bank in days.items()}
         hists = np.asarray(best_histogram(self.state.hll_regs,
                                           self.config.hll_precision))
         return {day: int(round(estimate_from_histogram(
             hists[bank], self.config.hll_precision)))
-            for day, bank in self._bank_of.items()}
+            for day, bank in days.items()}
 
     def cleanup(self) -> None:
         # Wait out any in-flight background snapshot before closing the

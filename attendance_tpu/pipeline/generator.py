@@ -63,11 +63,19 @@ def generate_student_data(
         now: Optional[datetime] = None,
         seed: Optional[int] = None,
         throttle_s: float = 0.0,
-        keep_events: bool = True) -> GeneratorReport:
+        keep_events: bool = True,
+        disorder_frac: float = 0.0,
+        late_max_s: float = 0.0) -> GeneratorReport:
     """Generate the reference's event mix; returns the ground-truth report.
 
     producer: transport producer with .send(bytes) (None = don't publish).
     sketch_store: SketchStore for the Bloom preload (None = skip preload).
+    disorder_frac/late_max_s: with a nonzero fraction, events are
+    EMITTED in event-time order except that a ``disorder_frac`` sample
+    has its arrival delayed by up to ``late_max_s`` of event time —
+    out-of-order/late swipes, deterministic per ``seed`` (the
+    timestamps themselves are untouched). The default (0) keeps the
+    reference's per-student emission order.
     """
     rng = random.Random(seed)
     now = now or datetime.now()
@@ -99,8 +107,9 @@ def generate_student_data(
                     len(report.valid_student_ids))
 
     past_week = [now - timedelta(days=i) for i in range(7)]
+    staged: list = [] if disorder_frac > 0 else None
 
-    def emit(event: AttendanceEvent) -> None:
+    def deliver(event: AttendanceEvent) -> None:
         if producer is not None:
             producer.send(encode_event(event))
         if keep_events:
@@ -115,6 +124,12 @@ def generate_student_data(
         if throttle_s:
             import time
             time.sleep(throttle_s)
+
+    def emit(event: AttendanceEvent) -> None:
+        if staged is None:
+            deliver(event)
+        else:
+            staged.append(event)
 
     def lecture_of(ts: datetime) -> str:
         return f"LECTURE_{ts.strftime('%Y%m%d')}"
@@ -146,6 +161,22 @@ def generate_student_data(
                          second=0, microsecond=0)
         emit(AttendanceEvent(invalid_id, ts.isoformat(), lecture_of(ts),
                              False, "entry"))
+
+    if staged is not None:
+        # Disordered emission: events flow in event-time order except
+        # that a sampled fraction arrives up to late_max_s of event
+        # time later (arrival key = timestamp + sampled delay;
+        # timestamps themselves untouched). Deterministic: the delay
+        # draws ride the same seeded rng, in staged order.
+        delays = [
+            timedelta(seconds=rng.uniform(0, late_max_s))
+            if rng.random() < disorder_frac else timedelta(0)
+            for _ in staged]
+        arrival = [
+            (datetime.fromisoformat(e.timestamp) + d, i)
+            for i, (e, d) in enumerate(zip(staged, delays))]
+        for _, i in sorted(arrival):
+            deliver(staged[i])
 
     logger.info("Total messages sent: %d (%d invalid attempts)",
                 report.message_count, report.invalid_attempts)

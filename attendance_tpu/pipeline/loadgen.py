@@ -71,25 +71,73 @@ def frame_from_columns(cols: dict, planar: bool = True) -> bytes:
     return BINARY_MAGIC + rec.tobytes()
 
 
+def stream_micros(rng: np.random.Generator, n: int, cursor: int,
+                  mean_gap_us: int = 1000) -> np.ndarray:
+    """Monotone event-time stamps continuing from ``cursor``: the
+    ordered stream clock the temporal (watermark) workloads need —
+    the default uniform-within-a-day stamps have no arrival order at
+    all, so "out of order" would be meaningless against them."""
+    gaps = rng.integers(1, max(2 * mean_gap_us, 2), n)
+    return (np.int64(cursor) + np.cumsum(gaps)).astype(np.int64)
+
+
+def apply_disorder(micros: np.ndarray, rng: np.random.Generator,
+                   disorder_frac: float, late_max_s: float
+                   ) -> np.ndarray:
+    """Displace a ``disorder_frac`` sample of events BACKWARD in event
+    time by up to ``late_max_s`` (arrival position unchanged): each
+    displaced event arrives out of order, trailing the stream head by
+    at most ``late_max_s`` — deterministic per generator state, so a
+    seed fully reproduces the disordered stream."""
+    if disorder_frac <= 0 or late_max_s <= 0:
+        return micros
+    out = np.array(micros, np.int64)
+    pick = rng.random(len(out)) < disorder_frac
+    n_pick = int(pick.sum())
+    if n_pick:
+        out[pick] -= rng.integers(1, int(late_max_s * 1e6) + 1,
+                                  n_pick)
+    return out
+
+
 def generate_frames(num_events: int, batch: int,
                     roster_size: int = 100_000, num_lectures: int = 64,
                     invalid_fraction: float = 0.1,
                     seed: Optional[int] = 0,
+                    disorder_frac: float = 0.0,
+                    late_max_s: float = 0.0,
+                    ordered: bool = False,
+                    mean_gap_us: int = 1000,
                     ) -> Tuple[np.ndarray, Iterator[bytes]]:
-    """(roster, iterator of bulk frames totalling num_events events)."""
+    """(roster, iterator of bulk frames totalling num_events events).
+
+    ``ordered=True`` (implied by a nonzero ``disorder_frac``) replaces
+    the uniform-within-a-day timestamps with a monotone stream clock;
+    ``disorder_frac``/``late_max_s`` then displace that fraction of
+    events back in event time by up to that many seconds — the
+    out-of-order/late swipe knobs the reorder stage, the temporal
+    soaks, and ``bench.py --mode temporal`` exercise."""
     rng = np.random.default_rng(seed)
     roster = rng.choice(np.arange(10_000, 10_000 + 4 * roster_size,
                                   dtype=np.uint32),
                         size=roster_size, replace=False)
     invalid_base = max(100_000, 10_000 + 4 * roster_size)
+    ordered = ordered or disorder_frac > 0
 
     def frames():
         left = num_events
+        cursor = _BASE_MICROS
         while left > 0:
             n = min(batch, left)
-            yield frame_from_columns(synth_columns(
-                rng, n, roster, num_lectures, invalid_fraction,
-                invalid_base=invalid_base))
+            cols = synth_columns(rng, n, roster, num_lectures,
+                                 invalid_fraction,
+                                 invalid_base=invalid_base)
+            if ordered:
+                micros = stream_micros(rng, n, cursor, mean_gap_us)
+                cursor = int(micros[-1])
+                cols["micros"] = apply_disorder(
+                    micros, rng, disorder_frac, late_max_s)
+            yield frame_from_columns(cols)
             left -= n
 
     return roster, frames()

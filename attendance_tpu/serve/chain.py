@@ -148,7 +148,12 @@ class ChainEpochSource:
                 bloom_words=np.asarray(state["bits"], np.uint32),
                 hll_regs=np.asarray(state["regs"], np.uint8),
                 counts=np.asarray(state["counts"], np.uint32),
-                bank_of=dict(state["bank_of"]), params=params,
+                # Manifest JSON stringifies the day/bucket keys;
+                # every epoch consumer (pfcount's bank lookup, the
+                # window verbs' bucket decode) keys by INT.
+                bank_of={int(d): int(b)
+                         for d, b in state["bank_of"].items()},
+                params=params,
                 precision=int(man["precision"]), source="chain",
                 # Staleness must describe the DATA, not this reader's
                 # load time: an hour-old chain served by a

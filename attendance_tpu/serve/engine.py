@@ -36,8 +36,11 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from attendance_tpu.models.bloom import bloom_contains_words_np
-from attendance_tpu.models.hll import estimates_from_rows
+from attendance_tpu.models.hll import (
+    estimate_from_histogram, estimates_from_rows, hll_histograms_np)
 from attendance_tpu.serve.mirror import Epoch
+from attendance_tpu.temporal.buckets import (
+    decode_bucket_key, is_bucket_key)
 
 
 class NoEpoch(RuntimeError):
@@ -174,18 +177,38 @@ class QueryEngine:
         self._note("pfcount", len(days), epoch, t0)
         return out
 
+    @staticmethod
+    def _day_map(epoch: Epoch) -> Dict[int, int]:
+        """The epoch's PLAIN-day bank entries (temporal bucket keys
+        share the map but belong to the window verbs)."""
+        return {d: b for d, b in epoch.bank_of.items()
+                if not is_bucket_key(d)}
+
+    @staticmethod
+    def _bucket_map(epoch: Epoch) -> Dict[tuple, int]:
+        """{(day, period): bank} decoded from the epoch's temporal
+        bucket keys — everything the window verbs need; no live-ring
+        state required, so chain readers and federation aggregators
+        answer identically."""
+        out = {}
+        for key, bank in epoch.bank_of.items():
+            if is_bucket_key(key):
+                out[decode_bucket_key(key)] = bank
+        return out
+
     def occupancy(self) -> Dict[int, int]:
         """The full per-lecture occupancy table {day: unique} from one
         batched histogram pass over every registered bank."""
         t0 = time.perf_counter()
         epoch = self.pin()
-        if not epoch.bank_of:
+        day_map = self._day_map(epoch)
+        if not day_map:
             self._note("occupancy", 0, epoch, t0)
             return {}
-        days = np.fromiter(epoch.bank_of.keys(), dtype=np.int64,
-                           count=len(epoch.bank_of))
-        banks = np.fromiter(epoch.bank_of.values(), dtype=np.int64,
-                            count=len(epoch.bank_of))
+        days = np.fromiter(day_map.keys(), dtype=np.int64,
+                           count=len(day_map))
+        banks = np.fromiter(day_map.values(), dtype=np.int64,
+                            count=len(day_map))
         ests = np.rint(estimates_from_rows(
             epoch.hll_regs[banks], epoch.precision)).astype(np.int64)
         out = {int(d): int(e) for d, e in zip(days, ests)}
@@ -201,17 +224,87 @@ class QueryEngine:
         epoch = self.pin()
         denom = int(roster_size) or epoch.roster_size
         table = {}
-        if denom > 0 and epoch.bank_of:
-            days = np.fromiter(epoch.bank_of.keys(), dtype=np.int64,
-                               count=len(epoch.bank_of))
-            banks = np.fromiter(epoch.bank_of.values(), dtype=np.int64,
-                                count=len(epoch.bank_of))
+        day_map = self._day_map(epoch)
+        if denom > 0 and day_map:
+            days = np.fromiter(day_map.keys(), dtype=np.int64,
+                               count=len(day_map))
+            banks = np.fromiter(day_map.values(), dtype=np.int64,
+                                count=len(day_map))
             ests = estimates_from_rows(epoch.hll_regs[banks],
                                        epoch.precision)
             table = {int(d): float(e) / denom
                      for d, e in zip(days, ests)}
         self._note("rate", len(table), epoch, t0)
         return table
+
+    # -- window verbs (temporal plane) ---------------------------------------
+    @staticmethod
+    def _merged_estimate(epoch: Epoch, banks) -> float:
+        """PFMERGE-then-estimate over a set of bucket rows: ONE
+        register-max fold (``hll_merge_np`` semantics), one histogram,
+        one Ertl estimate — the single fold implementation both
+        window verbs share."""
+        merged = np.max(epoch.hll_regs[np.asarray(banks, np.int64)],
+                        axis=0)
+        hist = hll_histograms_np(merged[None, :], epoch.precision)[0]
+        return estimate_from_histogram(hist, epoch.precision)
+
+    def window_pfcount(self, day: Optional[int] = None,
+                       period_lo: Optional[int] = None,
+                       period_hi: Optional[int] = None) -> int:
+        """Unique valid students across every bucket matching
+        ``day`` (None = all days) and the inclusive period range —
+        merge-on-read: ONE ``hll_merge_np``-style register-max fold
+        over the selected bucket rows, then one Ertl estimate. "Who
+        attended this week" = the day's buckets folded — the PAPER
+        §0.3 date-key divergence, answered."""
+        t0 = time.perf_counter()
+        epoch = self.pin()
+        rows = [bank for (d, p), bank in self._bucket_map(epoch).items()
+                if (day is None or d == int(day))
+                and (period_lo is None or p >= int(period_lo))
+                and (period_hi is None or p <= int(period_hi))]
+        out = (int(round(self._merged_estimate(epoch, rows)))
+               if rows else 0)
+        self._note("window_pfcount", len(rows), epoch, t0)
+        return out
+
+    def window_occupancy(self) -> Dict[tuple, int]:
+        """{(day, period): unique} over every retained bucket — one
+        batched histogram pass, the temporal twin of occupancy()."""
+        t0 = time.perf_counter()
+        epoch = self.pin()
+        bmap = self._bucket_map(epoch)
+        out: Dict[tuple, int] = {}
+        if bmap:
+            pairs = list(bmap.items())
+            banks = np.asarray([b for _, b in pairs], np.int64)
+            ests = np.rint(estimates_from_rows(
+                epoch.hll_regs[banks],
+                epoch.precision)).astype(np.int64)
+            out = {dp: int(e) for (dp, _), e in zip(pairs, ests)}
+        self._note("window_occupancy", len(out), epoch, t0)
+        return out
+
+    def rate_series(self, day: Optional[int] = None,
+                    roster_size: int = 0) -> Dict[int, float]:
+        """{period: attendance rate} — per-period unique/roster. With
+        ``day`` set, that day's series; without, buckets of the same
+        period fold across days (register-max) first, so the series
+        reads as fleet-wide occupancy over time."""
+        t0 = time.perf_counter()
+        epoch = self.pin()
+        denom = int(roster_size) or epoch.roster_size
+        out: Dict[int, float] = {}
+        if denom > 0:
+            by_period: Dict[int, list] = {}
+            for (d, p), bank in self._bucket_map(epoch).items():
+                if day is None or d == int(day):
+                    by_period.setdefault(p, []).append(bank)
+            for p, banks in sorted(by_period.items()):
+                out[p] = self._merged_estimate(epoch, banks) / denom
+        self._note("rate_series", len(out), epoch, t0)
+        return out
 
     def stats(self) -> Dict:
         """Epoch metadata + validity counters (the doctor/health verb
@@ -231,7 +324,8 @@ class QueryEngine:
             "published_at": epoch.published_at,
             "age_s": round(epoch.age_s(), 6),
             "events": epoch.events,
-            "banks": len(epoch.bank_of),
+            "banks": len(self._day_map(epoch)),
+            "window_buckets": len(self._bucket_map(epoch)),
             "roster_size": epoch.roster_size,
             "valid": valid,
             "invalid": invalid,
@@ -241,7 +335,8 @@ class QueryEngine:
         return out
 
     def execute(self, verb: str, *, keys=None, days=None,
-                roster_size: int = 0):
+                roster_size: int = 0, day=None, period_lo=None,
+                period_hi=None):
         """Dispatch one request by verb name (the wire surfaces'
         single entry point)."""
         if verb == "exists":
@@ -252,6 +347,12 @@ class QueryEngine:
             return self.occupancy()
         if verb == "rate":
             return self.attendance_rate(roster_size)
+        if verb == "window_pfcount":
+            return self.window_pfcount(day, period_lo, period_hi)
+        if verb == "window_occupancy":
+            return self.window_occupancy()
+        if verb == "rate_series":
+            return self.rate_series(day, roster_size)
         if verb == "stats":
             return self.stats()
         raise ValueError(f"unknown query verb {verb!r}")

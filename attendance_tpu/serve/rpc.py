@@ -46,6 +46,16 @@ Q_PFCOUNT = 2
 Q_OCCUPANCY = 3
 Q_RATE = 4
 Q_STATS = 5
+# Temporal window verbs (the windowed-HLL bucket plane):
+# * Q_WINDOW      — body ``i64 day (-1 = all), i64 p_lo (-1 = open),
+#   i64 p_hi (-1 = open)``; reply ``u64 estimate``.
+# * Q_WOCC        — empty body; reply ``u32 n, n*(i64 day, i64
+#   period, u64 est)``.
+# * Q_RATESERIES  — body ``i64 day (-1 = all), u64 roster_size``;
+#   reply ``u32 n, n*(i64 period, f64 rate)``.
+Q_WINDOW = 6
+Q_WOCC = 7
+Q_RATESERIES = 8
 
 _ST_OK = 0
 _ST_ERROR = 2
@@ -142,6 +152,28 @@ class QueryServer:
             for day in sorted(table):
                 parts.append(struct.pack("<qd", day, table[day]))
             return b"".join(parts)
+        if op == Q_WINDOW:
+            day, p_lo, p_hi = struct.unpack_from("<qqq", body)
+            est = eng.window_pfcount(
+                None if day < 0 else day,
+                None if p_lo < 0 else p_lo,
+                None if p_hi < 0 else p_hi)
+            return struct.pack("<Q", est)
+        if op == Q_WOCC:
+            table = eng.window_occupancy()
+            parts = [struct.pack("<I", len(table))]
+            for (day, period) in sorted(table):
+                parts.append(struct.pack("<qqQ", day, period,
+                                         table[(day, period)]))
+            return b"".join(parts)
+        if op == Q_RATESERIES:
+            day, roster = struct.unpack_from("<qQ", body)
+            series = eng.rate_series(None if day < 0 else day, roster)
+            parts = [struct.pack("<I", len(series))]
+            for period in sorted(series):
+                parts.append(struct.pack("<qd", period,
+                                         series[period]))
+            return b"".join(parts)
         if op == Q_STATS:
             return json.dumps(eng.stats()).encode()
         raise ValueError(f"unknown query opcode {op}")
@@ -217,6 +249,36 @@ class QueryClient:
         for i in range(n):
             day, rate = struct.unpack_from("<qd", reply, 4 + 16 * i)
             out[day] = rate
+        return out
+
+    def window_pfcount(self, day=None, period_lo=None,
+                       period_hi=None) -> int:
+        body = struct.pack("<qqq",
+                           -1 if day is None else int(day),
+                           -1 if period_lo is None else int(period_lo),
+                           -1 if period_hi is None else int(period_hi))
+        (est,) = struct.unpack("<Q", self._call(Q_WINDOW, body))
+        return int(est)
+
+    def window_occupancy(self) -> dict:
+        reply = self._call(Q_WOCC, b"")
+        (n,) = struct.unpack_from("<I", reply)
+        out = {}
+        for i in range(n):
+            day, period, est = struct.unpack_from("<qqQ", reply,
+                                                  4 + 24 * i)
+            out[(day, period)] = est
+        return out
+
+    def rate_series(self, day=None, roster_size: int = 0) -> dict:
+        body = struct.pack("<qQ", -1 if day is None else int(day),
+                           int(roster_size))
+        reply = self._call(Q_RATESERIES, body)
+        (n,) = struct.unpack_from("<I", reply)
+        out = {}
+        for i in range(n):
+            period, rate = struct.unpack_from("<qd", reply, 4 + 16 * i)
+            out[period] = rate
         return out
 
     def stats(self) -> dict:

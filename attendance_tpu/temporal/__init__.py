@@ -1,0 +1,19 @@
+"""Temporal sketch plane: windowed HLL banks, watermarked disorder
+handling, and the Count-Min gate-fraud detector.
+
+Everything here rides the EXISTING planes rather than duplicating
+them: temporal buckets are (day, period) pairs encoded as synthetic
+bank keys (:mod:`temporal.buckets`) living in the same
+``uint8[num_banks, 2^p]`` HLL register array and the same ``bank_of``
+map as the per-day banks — so the PR 4 dirty-bank delta chain
+persists them unchanged, the PR 7 epoch mirror serves them
+merge-on-read, and the PR 8 federation frames replicate them with no
+new wire. The watermark/reorder stage (:mod:`temporal.reorder`) and
+the ring bookkeeping (:mod:`temporal.windows`) are pure host logic;
+:mod:`temporal.plane` wires them into the fused pipeline behind one
+``is not None`` branch.
+"""
+
+from attendance_tpu.temporal.buckets import (  # noqa: F401
+    BUCKET_KEY_BASE, bucket_key, decode_bucket_key, is_bucket_key,
+    period_of)

@@ -329,27 +329,40 @@ class _Subscription:
     def requeue_inflight(self, owner: int) -> None:
         """Crash takeover: return the closing consumer's own unacked
         messages (per-message AND chunk entries) to the queue; other
-        consumers' deliveries stay theirs."""
+        consumers' deliveries stay theirs.
+
+        Requeued entries go to the HEAD of the pending queue, in
+        publish (message-id) order: a successor consumer then replays
+        the dead consumer's window BEFORE the undelivered backlog —
+        the same resume-from-durable-cursor order the shm ring gives.
+        Tail requeue (the old behavior) replayed the crash window
+        AFTER the whole backlog, an arbitrarily large delivery
+        reordering that an event-time consumer (the temporal plane's
+        watermark) cannot bound a lateness budget for — the temporal
+        soak caught redelivered events landing behind rotated buckets
+        and side-channeling instead of counting."""
         with self.cond:
-            mine = [(mid, d, r, p)
+            mine = [(mid, d, r + 1, p)
                     for mid, (d, r, o, p) in self.inflight.items()
                     if o == owner]
-            for mid, data, redeliveries, props in mine:
+            for mid, _, _, _ in mine:
                 del self.inflight[mid]
-                self._append_one((mid, data, redeliveries + 1, props))
             my_chunks = [cid for cid, (_, o) in self.chunk_inflight.items()
                          if o == owner]
-            chunk_msgs = 0
             for cid in my_chunks:
                 popped, _ = self.chunk_inflight.pop(cid)
-                chunk_msgs += len(popped)
-                self._append_block(
-                    [(mid, data, red + 1, props)
-                     for mid, data, red, props in popped])
-            if mine or my_chunks:
+                mine.extend((mid, data, red + 1, props)
+                            for mid, data, red, props in popped)
+            if mine:
+                # Message ids are allocated monotonically at publish,
+                # so sorting restores the exact original order across
+                # the per-message and chunk in-flight maps.
+                mine.sort(key=lambda t: t[0])
+                self._blocks.appendleft([mine, 0])
+                self._count += len(mine)
                 self.cond.notify_all()
                 if self._obs_redelivered is not None:
-                    self._obs_redelivered.inc(len(mine) + chunk_msgs)
+                    self._obs_redelivered.inc(len(mine))
 
     def backlog(self) -> int:
         with self.cond:

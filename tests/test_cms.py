@@ -1,0 +1,167 @@
+"""Count-Min sketch + top-K kernel (models/cms.py): device-vs-numpy
+differential identity, the one-sided error contract against an exact
+dict oracle (property tests over random streams), and heavy-hitter
+recovery with zero misses.
+"""
+
+import numpy as np
+import pytest
+
+from attendance_tpu.models.cms import (
+    TopK, cms_init, cms_init_np, cms_positions_np, cms_query,
+    cms_query_np, cms_step, cms_update, cms_update_np,
+    make_jitted_cms_step)
+
+
+def _exact_counts(keys):
+    vals, counts = np.unique(keys, return_counts=True)
+    return dict(zip(vals.tolist(), counts.tolist()))
+
+
+@pytest.mark.parametrize("seed", [11, 22, 33])
+def test_device_matches_numpy_twin(seed):
+    """Same murmur3 lanes, same scatter semantics: the device CMS and
+    the host twin must hold IDENTICAL count arrays after identical
+    streams, and answer identical estimates."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    depth, width = 3, 512
+    keys = rng.integers(0, 5_000, 4_096).astype(np.uint32)
+    dev = cms_init(depth, width)
+    dev = cms_update(dev, jnp.asarray(keys))
+    host = cms_init_np(depth, width)
+    cms_update_np(host, keys)
+    assert (np.asarray(dev) == host).all()
+    probes = np.concatenate([keys[:512], rng.integers(
+        10_000, 20_000, 256).astype(np.uint32)])
+    assert (np.asarray(cms_query(dev, jnp.asarray(probes)))
+            == cms_query_np(host, probes)).all()
+
+
+@pytest.mark.parametrize("seed", [5, 6, 7, 8])
+def test_one_sided_error_vs_exact_oracle(seed):
+    """The CMS contract, property-tested: estimates NEVER undercount
+    (fraud can't hide), and overcount stays within the e*N/width
+    bound for every probed key — on both paths."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    depth, width = 4, 2_048
+    n = 20_000
+    # Zipf-flavored stream: a few hot keys over a long tail.
+    keys = np.where(rng.random(n) < 0.3,
+                    rng.integers(0, 8, n),
+                    rng.integers(100, 50_000, n)).astype(np.uint32)
+    exact = _exact_counts(keys)
+    host = cms_init_np(depth, width)
+    cms_update_np(host, keys)
+    probes = np.unique(keys)
+    ests = cms_query_np(host, probes)
+    truth = np.array([exact[int(k)] for k in probes])
+    assert (ests >= truth).all(), "CMS undercounted (impossible)"
+    bound = np.e * n / width  # classic CMS overcount bound
+    assert (ests.astype(np.int64) - truth <= bound).all()
+    dev = cms_update(cms_init(depth, width), jnp.asarray(keys))
+    assert (np.asarray(cms_query(dev, jnp.asarray(probes))) == ests
+            ).all()
+
+
+def test_masked_lanes_do_not_count():
+    import jax.numpy as jnp
+
+    keys = np.arange(100, dtype=np.uint32)
+    mask = np.zeros(100, bool)
+    mask[:50] = True
+    dev = cms_update(cms_init(2, 256), jnp.asarray(keys),
+                     jnp.asarray(mask))
+    est = cms_query_np(np.asarray(dev), keys)
+    assert (est[:50] >= 1).all()
+    assert int(np.asarray(dev).sum()) == 50 * 2  # only unmasked lanes
+
+
+def test_fused_step_estimates_post_update():
+    """cms_step answers AFTER folding the batch: a key's estimate at
+    its last occurrence equals its running count (per duplicates in
+    the batch too)."""
+    import jax.numpy as jnp
+
+    keys = np.array([7, 7, 7, 9], np.uint32)
+    step = make_jitted_cms_step(donate=False)
+    counts, est = step(cms_init(3, 128), jnp.asarray(keys),
+                      jnp.ones(4, bool))
+    est = np.asarray(est)
+    assert est[0] == est[1] == est[2] == 3  # post-batch estimate
+    assert est[3] == 1
+    counts2, est2 = cms_step(counts, jnp.asarray(keys))
+    assert np.asarray(est2)[2] == 6
+
+
+def test_duplicate_scatter_adds_sum():
+    """XLA scatter-add must sum colliding in-batch indices — 1000
+    copies of one key count 1000, not 1."""
+    import jax.numpy as jnp
+
+    keys = np.full(1_000, 42, np.uint32)
+    dev = cms_update(cms_init(2, 64), jnp.asarray(keys))
+    assert int(cms_query_np(np.asarray(dev),
+                            np.array([42], np.uint32))[0]) == 1_000
+
+
+def test_positions_distinct_rows():
+    keys = np.arange(1_000, dtype=np.uint32)
+    pos = cms_positions_np(keys, 4, 1 << 12)
+    # Independent lanes: rows must not all agree (prob ~0 at width 4k).
+    assert not np.array_equal(pos[0], pos[1])
+    assert pos.min() >= 0 and pos.max() < (1 << 12)
+
+
+@pytest.mark.parametrize("seed", [101, 202])
+def test_topk_recovers_heavy_hitters_zero_misses(seed):
+    """Seeded hot keys at 50x background rate: the CMS+TopK pattern
+    must recover EVERY one of them (the fraud gate's zero-miss
+    acceptance), judged against the exact dict oracle."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    hot = rng.choice(10_000, 8, replace=False).astype(np.uint32)
+    n = 30_000
+    keys = np.where(rng.random(n) < 0.4,
+                    hot[rng.integers(0, len(hot), n)],
+                    rng.integers(100_000, 1_000_000, n)
+                    ).astype(np.uint32)
+    exact = _exact_counts(keys)
+    top_truth = sorted(exact, key=exact.get, reverse=True)[:8]
+    assert set(top_truth) == set(int(h) for h in hot)
+    step = make_jitted_cms_step(donate=False)
+    counts = cms_init(4, 1 << 13)
+    topk = TopK(12)
+    for i in range(0, n, 4_096):
+        batch = keys[i:i + 4_096]
+        pad = np.zeros(4_096, np.uint32)
+        pad[:len(batch)] = batch
+        mask = np.zeros(4_096, bool)
+        mask[:len(batch)] = True
+        counts, est = step(counts, jnp.asarray(pad), jnp.asarray(mask))
+        topk.offer(batch, np.asarray(est)[:len(batch)])
+    got = {k for k, _ in topk.items()}
+    assert set(int(h) for h in hot) <= got, "top-K missed a hot key"
+    # Estimates for the hot keys are exact-or-over, never under.
+    for key, est in topk.items():
+        if key in exact:
+            assert est >= exact[key] or est >= exact[key] * 0.99
+
+
+def test_topk_bounds_and_validation():
+    with pytest.raises(ValueError):
+        TopK(0)
+    with pytest.raises(ValueError):
+        cms_init(0, 16)
+    t = TopK(2)
+    t.offer(np.array([1, 2, 3, 4], np.uint32),
+            np.array([10, 40, 30, 20], np.uint64))
+    assert [k for k, _ in t.items()] == [2, 3]
+    assert len(t) == 2
+    # A later, larger sighting of an evicted key re-enters.
+    t.offer(np.array([1], np.uint32), np.array([99], np.uint64))
+    assert [k for k, _ in t.items()] == [1, 2]

@@ -69,6 +69,22 @@ ROT_SPEC = ("drop=0.02,dup=0.02,conn_reset=0.02,corrupt=0.02,"
 # stalled writer against the mmap ring, with a REAL SIGKILL of the
 # consumer mid-ring and a cursor-resume recovery (run_shm_soak).
 SHM_SPEC = "torn_slot=0.08,writer_stall=15ms:0.05"
+# The temporal-plane soak (``--spec temporal``, ISSUE 14): a
+# disordered (disorder <= allowed lateness) ordered-clock stream with
+# a super-late tail, through a delta-checkpointing temporal pipeline
+# that is SIGKILLed once its chain holds a delta (mid-window, between
+# rotations), then restored in-process — the restored run's windowed
+# estimates must equal the no-crash oracle EXACTLY, the day plane and
+# store must show zero acked loss, and the late counters must have
+# fired. Snapshot-writer faults only: transport faults that REORDER
+# delivery (drop/dup/conn_reset redelivery) would displace events
+# beyond any fixed lateness budget by design — the lateness margin
+# here is sized for the one reordering this soak proves (the kill's
+# own redelivery window), not for arbitrary transport chaos.
+TEMPORAL_SPEC = "snap_fail=0.05,writer_stall=20ms:0.05"
+TEMPORAL_PERIOD_S = 4.0
+TEMPORAL_LATENESS_S = 8.0
+TEMPORAL_TAIL = 64
 NUM_EVENTS, BATCH = 32_768, 512
 ROSTER, LECTURES = 10_000, 8
 POISON_FRAMES = 2
@@ -548,6 +564,240 @@ def run_shm_soak(seed: int, *, workdir,
     return report
 
 
+def _temporal_frames(seed: int):
+    """(roster, frames): an ordered disordered stream (25% of events
+    up to 2s late — well inside the 8s lateness budget) plus a
+    super-late TAIL re-sending the first frame's (by then ancient)
+    events, which must side-channel as dropped in oracle and chaos
+    runs alike."""
+    import numpy as np
+
+    from attendance_tpu.pipeline.events import decode_planar_batch
+    from attendance_tpu.pipeline.loadgen import (
+        frame_from_columns, generate_frames)
+
+    roster, frames = generate_frames(
+        NUM_EVENTS, BATCH, roster_size=ROSTER,
+        num_lectures=LECTURES, invalid_fraction=0.1,
+        seed=DATA_SEED_BASE + seed, disorder_frac=0.25,
+        late_max_s=2.0, ordered=True)
+    frames = list(frames)
+    head = decode_planar_batch(frames[0])
+    tail = {k: np.array(v[:TEMPORAL_TAIL]) for k, v in head.items()}
+    frames.append(frame_from_columns(tail))
+    return roster, frames
+
+
+def _temporal_config(snap_dir, **kw):
+    from attendance_tpu.config import Config
+
+    return Config(
+        bloom_filter_capacity=50_000,
+        temporal_period_s=TEMPORAL_PERIOD_S,
+        allowed_lateness_s=TEMPORAL_LATENESS_S,
+        temporal_ring_banks=128,
+        snapshot_dir=str(snap_dir) if snap_dir else "",
+        snapshot_mode="delta",
+        snapshot_every_batches=4, **kw).validate()
+
+
+def _temporal_state(pipe) -> dict:
+    state = _state(pipe)
+    state["windows"] = {str(k): v
+                       for k, v in pipe.window_counts().items()}
+    return state
+
+
+def _temporal_oracle(seed: int) -> dict:
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    client = MemoryClient(MemoryBroker())
+    # NO snapshot dir (like _oracle): a chain dir shared across seeds
+    # or reruns would be RESTORED at init and pollute the oracle with
+    # the previous run's state — the oracle's correctness contract is
+    # the window math, not the chain.
+    pipe = FusedPipeline(
+        _temporal_config(None, transport_backend="memory"),
+        client=client, num_banks=LECTURES)
+    roster, frames = _temporal_frames(seed)
+    pipe.preload(roster)
+    producer = client.create_producer("attendance-events")
+    for frame in frames:
+        producer.send(frame)
+    pipe.run(max_events=NUM_EVENTS + TEMPORAL_TAIL, idle_timeout_s=2.0)
+    state = _temporal_state(pipe)
+    state["stats"] = {k: v for k, v in pipe.temporal_stats().items()
+                      if k != "topk"}
+    pipe.cleanup()
+    return state
+
+
+def _temporal_worker_main(args) -> None:
+    """The to-be-SIGKILLed half of the temporal soak: consume the
+    socket broker with delta checkpointing + the temporal plane until
+    the parent kills us."""
+    from attendance_tpu import chaos
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+
+    config = _temporal_config(
+        args.snapshot_dir, transport_backend="socket",
+        socket_broker=args.broker, chaos=TEMPORAL_SPEC,
+        chaos_seed=args.seed)
+    chaos.ensure(config)
+    roster, _ = _temporal_frames(args.seed)
+    pipe = FusedPipeline(config, num_banks=LECTURES)
+    pipe.preload(roster)
+    print("worker ready", flush=True)
+    pipe.run(idle_timeout_s=60.0)
+
+
+def run_temporal_soak(seed: int, *, workdir,
+                      max_seconds: float = 120.0) -> dict:
+    """The temporal soak (ISSUE 14): disordered stream + SIGKILL of a
+    delta-checkpointing temporal worker once its chain holds a delta,
+    in-process restore + drain, then the gates: restored window
+    estimates EXACTLY equal the no-crash oracle's, zero acked loss
+    (day counts / deduped rows / valid totals equal), late counters
+    fired (the super-late tail side-channeled), rotations happened,
+    and doctor passes with the watermark-lag ceiling."""
+    import json as _json
+    import signal
+    import subprocess
+
+    from attendance_tpu import chaos, obs
+
+    failures = []
+    t_start = time.monotonic()
+
+    def check(cond, label):
+        if not cond:
+            failures.append(label)
+
+    chaos.disable()
+    obs.disable()
+    want = _temporal_oracle(seed)
+
+    work = Path(workdir) / f"temporal-seed-{seed}"
+    work.mkdir(parents=True, exist_ok=True)
+    snap = work / "snaps"
+    prom = work / "metrics.prom"
+
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.transport import make_client
+    from attendance_tpu.transport.socket_broker import BrokerServer
+
+    server = BrokerServer().start()
+    roster, frames = _temporal_frames(seed)
+    pub_config = _temporal_config(snap, transport_backend="socket",
+                                  socket_broker=server.address)
+    pub_client = make_client(pub_config)
+    producer = pub_client.create_producer(pub_config.pulsar_topic)
+    for frame in frames:
+        producer.send(frame)
+
+    worker = subprocess.Popen(
+        [sys.executable, str(Path(__file__).resolve()),
+         "--temporal-worker", "--broker", server.address,
+         "--snapshot-dir", str(snap), "--seed", str(seed)],
+        stdout=subprocess.PIPE, text=True, cwd=str(REPO))
+    report = {"seed": seed, "spec": TEMPORAL_SPEC}
+    try:
+        check(worker.stdout.readline().strip() == "worker ready",
+              "temporal worker failed to start")
+        # SIGKILL the worker the moment its chain holds a delta —
+        # mid-window by construction (acks lag the barriers, buckets
+        # are mid-rotation across the whole stream).
+        chain_path = snap / "CHAIN.json"
+        deadline = time.monotonic() + max_seconds
+        while time.monotonic() < deadline:
+            try:
+                if _json.loads(chain_path.read_text()).get("deltas"):
+                    break
+            except (FileNotFoundError, ValueError):
+                pass
+            if worker.poll() is not None:
+                check(False, "temporal worker exited before the kill")
+                return dict(report, failures=failures, ok=False,
+                            wall_s=round(time.monotonic() - t_start,
+                                         1))
+            time.sleep(0.02)
+        else:
+            check(False, "no delta snapshot within the deadline")
+            return dict(report, failures=failures, ok=False,
+                        wall_s=round(time.monotonic() - t_start, 1))
+        worker.send_signal(signal.SIGKILL)
+        worker.wait()
+
+        # Restore IN PROCESS: the chain re-seeds the bucket ring, the
+        # broker's crash takeover redelivers the unacked tail (whose
+        # event-time displacement the 8s lateness budget covers), and
+        # the stream drains to the end — tail included.
+        config = _temporal_config(
+            snap, transport_backend="socket",
+            socket_broker=server.address,
+            metrics_prom=str(prom), metrics_interval_s=0.2)
+        obs.enable(config)
+        pipe = FusedPipeline(config, num_banks=LECTURES)
+        pipe.run(idle_timeout_s=3.0)
+        got = _temporal_state(pipe)
+        stats = {k: v for k, v in pipe.temporal_stats().items()
+                 if k != "topk"}
+        report["chaos_state_rows"] = got["rows"]
+        report["stats"] = stats
+        pipe.cleanup()
+
+        check(got["windows"] == want["windows"],
+              "restored window estimates diverged from the no-crash "
+              f"oracle: {got['windows']} != {want['windows']}")
+        check(got["counts"] == want["counts"],
+              f"day counts diverged: {got['counts']} != "
+              f"{want['counts']}")
+        check(got["rows"] == want["rows"]
+              and got["valid"] == want["valid"],
+              f"store rows/valid diverged: {got['rows']}/"
+              f"{got['valid']} != {want['rows']}/{want['valid']}")
+        # Late counters: oracle and chaos run both dropped the tail
+        # (counter totals span worker+restored process, so gate the
+        # restored process' >= share plus the oracle's exact count).
+        check(want["stats"]["late_dropped"] >= TEMPORAL_TAIL,
+              "oracle never dropped the super-late tail")
+        check(stats["late_dropped"] >= TEMPORAL_TAIL,
+              f"late-dropped counter never fired post-restore "
+              f"({stats['late_dropped']})")
+        check(stats["rotations"] > 0, "no bucket rotations observed")
+        check(stats["buckets"] > 0, "no temporal buckets restored")
+
+        # Doctor over the restored run's own artifacts, with the
+        # watermark-lag gate (steady-state lag == allowed lateness).
+        t = obs.get()
+        t.finalize_slo("soak-end")
+        if t._reporter is not None:
+            t._reporter._write_block()
+        from attendance_tpu.obs.slo import doctor_report
+        try:
+            text, ok = doctor_report(
+                [str(prom)],
+                watermark_lag_ceiling=TEMPORAL_LATENESS_S * 4)
+            report["doctor_ok"] = ok
+            check(ok, "doctor verdict FAIL:\n" + text)
+        except Exception as exc:  # noqa: BLE001
+            check(False, f"doctor raised: {exc!r}")
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.wait()
+        pub_client.close()
+        server.stop()
+        obs.disable()
+        chaos.disable()
+    report["wall_s"] = round(time.monotonic() - t_start, 1)
+    report["failures"] = failures
+    report["ok"] = not failures
+    return report
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, action="append", default=None,
@@ -569,7 +819,10 @@ def main() -> int:
                     help="per-seed deadline (termination invariant)")
     ap.add_argument("--shm-worker", action="store_true",
                     help=argparse.SUPPRESS)  # subprocess entry
+    ap.add_argument("--temporal-worker", action="store_true",
+                    help=argparse.SUPPRESS)  # subprocess entry
     ap.add_argument("--shm-dir", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--broker", default="", help=argparse.SUPPRESS)
     ap.add_argument("--snapshot-dir", default="",
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -577,11 +830,34 @@ def main() -> int:
         args.seed = (args.seed or [1])[0]
         _shm_worker_main(args)
         return 0
+    if args.temporal_worker:
+        args.seed = (args.seed or [1])[0]
+        _temporal_worker_main(args)
+        return 0
     if args.spec == "rot":
         args.spec = ROT_SPEC
     seeds = args.seed or [1]
     rc = 0
     for seed in seeds:
+        if args.spec == "temporal":
+            print(f"=== temporal chaos soak seed={seed}", flush=True)
+            report = run_temporal_soak(
+                seed, workdir=args.workdir,
+                max_seconds=max(args.max_seconds, 120.0))
+            summary = {k: v for k, v in report.items()
+                       if k not in ("failures", "stats")}
+            print(f"seed {seed}: {summary}", flush=True)
+            if not report["ok"]:
+                rc = 1
+                for f in report["failures"]:
+                    print(f"FAIL seed={seed}: {f}", flush=True)
+                print("SOAK FAIL — replay with:\n  JAX_PLATFORMS=cpu "
+                      f"python tools/chaos_soak.py --seed {seed} "
+                      "--spec temporal", flush=True)
+            else:
+                print(f"PASS seed={seed} ({report['wall_s']}s)",
+                      flush=True)
+            continue
         if args.spec == "shm":
             print(f"=== shm chaos soak seed={seed}", flush=True)
             report = run_shm_soak(seed, workdir=args.workdir,
