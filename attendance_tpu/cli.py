@@ -523,11 +523,42 @@ def cmd_telemetry(args) -> None:
     durations). The format is sniffed from the file content.
     ``--follow`` tails a LIVE file instead: the table re-renders every
     time the reporter appends a scrape block (or the trace/flight file
-    is atomically replaced), until interrupted."""
+    is atomically replaced), until interrupted. ``--attribution``
+    renders a sampling-profiler attribution document (or a
+    ``--profile-out`` directory containing one) as the per-stage
+    self-time table — wall %% by stage x thread role, plus the
+    recompile-fingerprint ledger."""
+    import json as _json
+    import os
     import sys
 
     from attendance_tpu.obs.exposition import format_file
 
+    if args.attribution:
+        from attendance_tpu.obs.profiler import (
+            ATTRIBUTION_FILE, format_attribution_table)
+
+        path = args.path
+        if os.path.isdir(path):
+            path = os.path.join(path, ATTRIBUTION_FILE)
+        try:
+            doc = _json.loads(open(path).read())
+            if doc.get("kind") != "attribution":
+                raise ValueError(
+                    "not an attribution document (expected "
+                    '"kind": "attribution" — the sampling '
+                    "profiler's attribution.json)")
+            print(format_attribution_table(doc))
+        except FileNotFoundError:
+            logger.error("no attribution artifact at %s (was the run "
+                         "profiled with --profile-hz/--profile-out?)",
+                         path)
+            sys.exit(2)
+        except Exception as e:
+            logger.error("unreadable attribution artifact %s: %s",
+                         path, e)
+            sys.exit(2)
+        return
     if args.follow:
         try:
             _follow_file(args.path, args.last, args.interval_s)
@@ -579,13 +610,14 @@ def _fleet_table(doc: dict) -> str:
             str(inst.get("spans", 0)),
             str(inst.get("events", "-")),
             str(inst.get("series", "-")),
+            str(inst.get("top_stage", "-")),
             str(inst.get("merge_lag_p99_s", "-")),
             str(inst.get("read_staleness_s", "-")),
             str(inst.get("slo_firing", 0)),
         ])
     return _table(rows, ["role@instance", "age", "pushes", "spans",
-                         "events", "series", "lag_p99", "staleness",
-                         "firing"])
+                         "events", "series", "top_stage", "lag_p99",
+                         "staleness", "firing"])
 
 
 def cmd_fleet(args) -> None:
@@ -696,7 +728,8 @@ def cmd_doctor(args) -> None:
                 query_p99_ceiling=args.query_p99_ceiling,
                 staleness_ceiling=args.staleness_ceiling,
                 merge_lag_ceiling=args.merge_lag_ceiling,
-                watermark_lag_ceiling=args.watermark_lag_ceiling)
+                watermark_lag_ceiling=args.watermark_lag_ceiling,
+                recompile_ceiling=args.recompile_ceiling)
         except FileNotFoundError as e:
             logger.error("no such fleet artifact dir: %s", e)
             sys.exit(2)
@@ -741,6 +774,7 @@ def cmd_doctor(args) -> None:
             staleness_ceiling=args.staleness_ceiling,
             merge_lag_ceiling=args.merge_lag_ceiling,
             watermark_lag_ceiling=args.watermark_lag_ceiling,
+            recompile_ceiling=args.recompile_ceiling,
             quarantine_dir=args.quarantine)
     except FileNotFoundError as e:
         logger.error("no such artifact: %s", e)
@@ -912,6 +946,11 @@ def main(argv=None) -> None:
                        "Chrome-trace JSON file")
     p_tel.add_argument("--last", type=int, default=32,
                        help="flight records / traces shown (most recent)")
+    p_tel.add_argument("--attribution", action="store_true",
+                       help="render a sampling-profiler attribution "
+                       "document (attribution.json, or the "
+                       "--profile-out dir holding one) as the "
+                       "per-stage self-time table")
     p_tel.add_argument("--follow", action="store_true",
                        help="tail a LIVE artifact: re-render the "
                        "table every time the file grows (a reporter "
@@ -988,6 +1027,15 @@ def main(argv=None) -> None:
                        "informational row. Set only for runs that "
                        "ran the temporal plane — an absent gauge "
                        "fails loudly, never vacuously")
+    p_doc.add_argument("--recompile-ceiling", type=int, default=None,
+                       help="gate attendance_recompiles_steady_total "
+                       "(jitted program variants compiled AFTER the "
+                       "first completed run loop — steady state must "
+                       "hold 0; a nonzero count means unpadded shapes "
+                       "leak into XLA). Set only for runs whose "
+                       "telemetry was on — an absent counter fails "
+                       "loudly, never vacuously; omitted = "
+                       "informational row")
     p_doc.add_argument("--merge-lag-ceiling", type=float, default=None,
                        help="gate the federation merge-lag p99 "
                        "(fence -> folded-into-global-view seconds) "

@@ -176,6 +176,25 @@ class Config:
     # the processing run (TensorBoard/XProf-loadable). Device dispatches
     # are TraceAnnotation-labelled so kernel time attributes to stages.
     profile_dir: str = ""
+    # Continuous host sampling profiler (obs/profiler.py; 0 = off):
+    # a background thread samples sys._current_frames() at this rate,
+    # folds per-thread collapsed stacks, and attributes every sample
+    # to the thread's current pipeline stage (dequeue/decode/dispatch/
+    # device_wait/temporal/snapshot/serve/...) — the per-stage
+    # SELF-TIME table `telemetry --attribution` renders and the trend
+    # gate diffs. Stage fractions export live as
+    # attendance_profile_stage_fraction{stage=} gauges (they ride
+    # fleet pushes for the dashboard's top-stage column). Hot threads
+    # pay only the stage-mark dict writes; sampling runs on its own
+    # thread. 29-97 Hz are good prime choices (avoid aliasing the
+    # snapshot cadence).
+    profile_hz: float = 0.0
+    # Artifact directory for the sampling profiler ("" = in-memory
+    # only: live gauges still export): profile.folded (flamegraph
+    # collapsed stacks), profile_trace.json (Perfetto stage
+    # timeline), attribution.json (the offline attribution table,
+    # incl. the recompile-fingerprint ledger).
+    profile_out: str = ""
     # Live telemetry (obs/): all four default OFF, and with every flag
     # unset the instrumented hot paths pay exactly one branch per event
     # (same discipline as profile_dir). metrics_prom appends a
@@ -427,6 +446,17 @@ class Config:
             raise ValueError(
                 "snapshot_compact_every must be positive (delta files "
                 "per chain before the writer folds a full base)")
+        if self.profile_hz < 0:
+            raise ValueError("profile_hz must be >= 0 (0 = off)")
+        if self.profile_hz > 1000:
+            raise ValueError(
+                "profile_hz above 1000 would make the sampler itself "
+                "the hot path — pick something in 10-250")
+        if self.profile_out and not self.profile_hz:
+            raise ValueError(
+                "--profile-out without --profile-hz writes nothing "
+                "(the sampler is off) — set a rate, e.g. "
+                "--profile-hz 29")
         if not (-1 <= self.metrics_port <= 65535):
             raise ValueError(
                 f"metrics_port out of range: {self.metrics_port} "
@@ -749,6 +779,15 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    "half-open probe")
     p.add_argument("--profile-dir", default=d.profile_dir,
                    help="write a jax.profiler trace of the run here")
+    p.add_argument("--profile-hz", type=float, default=d.profile_hz,
+                   help="host sampling-profiler rate (0 = off): "
+                   "per-stage self-time attribution, collapsed-stack "
+                   "flamegraph + Perfetto stage timeline under "
+                   "--profile-out")
+    p.add_argument("--profile-out", default=d.profile_out,
+                   help="artifact dir for the sampling profiler "
+                   "(profile.folded, profile_trace.json, "
+                   "attribution.json)")
     p.add_argument("--metrics-json", default=d.metrics_json,
                    help="append one JSON metrics line per run here")
     p.add_argument("--metrics-prom", default=d.metrics_prom,
@@ -859,6 +898,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         persist_breaker_failures=args.persist_breaker_failures,
         persist_breaker_cooldown_s=args.persist_breaker_cooldown_s,
         profile_dir=args.profile_dir,
+        profile_hz=args.profile_hz,
+        profile_out=args.profile_out,
         metrics_json=args.metrics_json,
         metrics_prom=args.metrics_prom,
         metrics_port=args.metrics_port,

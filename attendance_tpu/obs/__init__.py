@@ -100,6 +100,7 @@ def _atexit_flush() -> None:
         except Exception:
             logger.exception("atexit metrics block write failed")
     t.flush_trace("atexit")
+    t.flush_profile("atexit")
     if t._fleet is not None:
         try:
             t._fleet.push_now()  # last snapshot reaches the collector
@@ -133,7 +134,8 @@ def enabled_in(config) -> bool:
                 or getattr(config, "audit_sample", 0.0)
                 or getattr(config, "alert_log", "")
                 or getattr(config, "slo", None)
-                or getattr(config, "fleet_push", ""))
+                or getattr(config, "fleet_push", "")
+                or getattr(config, "profile_hz", 0.0))
 
 
 class Telemetry:
@@ -149,7 +151,8 @@ class Telemetry:
                  fleet_push: str = "", fleet_role: str = "",
                  fleet_instance: str = "",
                  fleet_push_interval_s: float = 2.0,
-                 metric_series_max: int = 1024):
+                 metric_series_max: int = 1024,
+                 profile_hz: float = 0.0, profile_out: str = ""):
         self.registry = Registry(max_series=metric_series_max)
         self.flight: Optional[FlightRecorder] = (
             FlightRecorder(flight_recorder) if flight_recorder > 0
@@ -187,6 +190,20 @@ class Telemetry:
                                  interval_s=min(metrics_interval_s,
                                                 max(slo_fast_s / 4,
                                                     0.05)))
+        # Attribution plane (obs/profiler.py): the host sampling
+        # profiler is created only at --profile-hz > 0 (its stage
+        # tracker is what the hot-path marks write into); the
+        # recompile tracker is always on when telemetry is — its cost
+        # is one set lookup per dispatch, and recompile storms are
+        # exactly the thing a metrics-only run must still see.
+        from attendance_tpu.obs.profiler import RecompileTracker
+        self.recompiles = RecompileTracker(self.registry)
+        self.profiler = None
+        if profile_hz > 0:
+            from attendance_tpu.obs.profiler import SamplingProfiler
+            self.profiler = SamplingProfiler(
+                profile_hz, registry=self.registry,
+                out_dir=profile_out)
         self._reporter = None
         self._server = None
         self._prev_sigusr1 = _NOT_INSTALLED
@@ -218,6 +235,8 @@ class Telemetry:
                                                  self.flight_path)
         if self.slo is not None:
             self.slo.start()
+        if self.profiler is not None:
+            self.profiler.start()
         if self._fleet_push:
             from attendance_tpu.obs.fleet import (
                 FleetPusher, default_instance)
@@ -228,7 +247,7 @@ class Telemetry:
                           or default_instance()),
                 interval_s=self._fleet_interval).start()
         if (self.tracer is not None or self._reporter is not None
-                or self.slo is not None):
+                or self.slo is not None or self.profiler is not None):
             # Backstop for CLI runs that never reach a run-loop flush
             # (KeyboardInterrupt, runs shorter than the reporter
             # interval); every flush is idempotent. ONE module-level
@@ -243,6 +262,12 @@ class Telemetry:
 
     def stop(self) -> None:
         self.flush_trace("telemetry-stop")
+        if self.profiler is not None:
+            # Sampler thread joined BEFORE the fleet drain below: the
+            # final push carries the profiler's last stage fractions,
+            # and stop() also writes the profile artifacts.
+            self.profiler.stop()
+            self.flush_profile("telemetry-stop")
         if self._fleet is not None:
             # Final push (incl. any spans recorded above) so a run
             # shorter than the push interval still reaches the
@@ -313,6 +338,23 @@ class Telemetry:
         if self.slo is not None:
             self.slo.finalize(reason)
 
+    # -- profiling -----------------------------------------------------------
+    def flush_profile(self, reason: str = "flush") -> None:
+        """Write the profile artifacts (collapsed stacks, stage
+        timeline, attribution.json) to --profile-out — idempotent,
+        no-op without a profiler or an out dir. The recompile ledger
+        rides into attribution.json here, so the offline table names
+        the shapes that compiled."""
+        p = self.profiler
+        if p is None or not p.out_dir or not p.samples:
+            return
+        try:
+            path = p.write(p.out_dir, recompiles=self.recompiles)
+            logger.info("Profile (%d samples) written under %s (%s)",
+                        p.samples, path.parent, reason)
+        except Exception:
+            logger.exception("Profile flush failed")
+
     # -- tracing -------------------------------------------------------------
     def flush_trace(self, reason: str = "flush") -> None:
         """Write the span buffer to ``--trace-out`` (atomic; no-op
@@ -376,7 +418,9 @@ def enable(config) -> Telemetry:
             fleet_push_interval_s=getattr(config,
                                           "fleet_push_interval_s", 2.0),
             metric_series_max=getattr(config, "metric_series_max",
-                                      1024))
+                                      1024),
+            profile_hz=getattr(config, "profile_hz", 0.0),
+            profile_out=getattr(config, "profile_out", ""))
         t.start()
         TELEMETRY = t
         return t

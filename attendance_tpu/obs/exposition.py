@@ -267,12 +267,22 @@ def _table(rows: List[List[str]], headers: List[str]) -> str:
     return "\n".join(out)
 
 
-def _parse_le(labels: str) -> Optional[float]:
+def label_value(labels: str, key: str) -> Optional[str]:
+    """Value of one label in a parse_prom labels string, unquoted;
+    None when absent — the ONE label-value parse every offline
+    consumer (doctor rows, fleet headlines, the le= bound below)
+    shares."""
     for part in labels.split(","):
-        if part.startswith("le="):
-            raw = part[3:].strip('"')
-            return float("inf") if raw == "+Inf" else float(raw)
+        if part.startswith(f"{key}="):
+            return part[len(key) + 1:].strip('"')
     return None
+
+
+def _parse_le(labels: str) -> Optional[float]:
+    raw = label_value(labels, "le")
+    if raw is None:
+        return None
+    return float("inf") if raw == "+Inf" else float(raw)
 
 
 def quantiles_from_cumulative(pairs, qs) -> List[float]:
@@ -318,7 +328,8 @@ def fold_headline_samples(samples, acc: Optional[dict] = None) -> dict:
     returned ``acc`` back in to accumulate across instances."""
     if acc is None:
         acc = {"events": 0.0, "have_events": False, "firing": 0,
-               "staleness": [], "series": None, "lag_by_le": {}}
+               "staleness": [], "series": None, "lag_by_le": {},
+               "prof_stages": {}}
     for name, labels, value in samples:
         try:
             v = float(value)
@@ -335,12 +346,31 @@ def fold_headline_samples(samples, acc: Optional[dict] = None) -> dict:
             acc["staleness"].append(v)
         elif name == "attendance_metric_series_total":
             acc["series"] = int(v)
+        elif name == "attendance_profile_stage_fraction":
+            # Sampling-profiler self-time per stage (ISSUE 15) — the
+            # fleet surfaces render each role's top stage from it.
+            stage = label_value(labels, "stage")
+            if stage is not None:
+                acc["prof_stages"][stage] = max(
+                    acc["prof_stages"].get(stage, 0.0), v)
         elif name == "attendance_fed_merge_lag_seconds_bucket":
             le = _parse_le(labels)
             if le is not None:
                 acc["lag_by_le"][le] = (acc["lag_by_le"].get(le, 0.0)
                                         + v)
     return acc
+
+
+def rank_profile_stages(fracs: dict, top: int = 3) -> list:
+    """Busiest-first (stage, fraction) pairs with marked stages
+    ranking above the untagged remainder (untagged shows only when it
+    is all there is) — the ONE ordering shared by the fleet
+    dashboard's ``top_stage`` cell and doctor's "profiled top stages"
+    row, so the two surfaces can never name different top stages for
+    the same exposition."""
+    tagged = {s: v for s, v in fracs.items() if s != "untagged"} \
+        or fracs
+    return sorted(tagged.items(), key=lambda kv: -kv[1])[:top]
 
 
 def format_prom_table(text: str) -> str:
@@ -465,6 +495,10 @@ def format_file(path: str, last: int = 32) -> str:
     stripped = text.lstrip()
     if stripped.startswith("{"):
         doc = json.loads(text)
+        if doc.get("kind") == "attribution":
+            from attendance_tpu.obs.profiler import (
+                format_attribution_table)
+            return format_attribution_table(doc)
         if "traceEvents" in doc:
             return format_trace_tree(doc, last=last)
         return format_flight_table(doc, last=last)

@@ -666,6 +666,13 @@ def _headline(prom_text: str) -> dict:
         out["read_staleness_s"] = round(max(acc["staleness"]), 3)
     if acc["series"] is not None:
         out["series"] = acc["series"]
+    if acc["prof_stages"]:
+        # The role's busiest profiled stage (sampling profiler on) —
+        # the dashboard's per-role "where does the time go" cell,
+        # ranked by the one shared ordering doctor's row also uses.
+        from attendance_tpu.obs.exposition import rank_profile_stages
+        stage, frac = rank_profile_stages(acc["prof_stages"], 1)[0]
+        out["top_stage"] = f"{stage} {frac:.0%}"
     pairs = sorted(acc["lag_by_le"].items())
     if pairs and max(c for _, c in pairs) > 0:
         (p99,) = quantiles_from_cumulative(pairs, (0.99,))

@@ -484,7 +484,8 @@ def _prom_checks(text: str, fpr_ceiling: float,
                  query_p99_ceiling: Optional[float] = None,
                  staleness_ceiling: Optional[float] = None,
                  merge_lag_ceiling: Optional[float] = None,
-                 watermark_lag_ceiling: Optional[float] = None
+                 watermark_lag_ceiling: Optional[float] = None,
+                 recompile_ceiling: Optional[int] = None
                  ) -> List[List[str]]:
     from attendance_tpu.obs.exposition import parse_prom
 
@@ -710,6 +711,78 @@ def _prom_checks(text: str, fpr_ceiling: float,
     if evictions and max(evictions) > 0:
         rows.append(["window buckets evicted (ring pressure)",
                      _fmt_value(max(evictions)), "-", "info"])
+    # Attribution plane (ISSUE 15): where the time went, which stage
+    # the dispatch thread spends itself on, how often the device sat
+    # idle between dispatches — informational context for every gate
+    # above — plus the RECOMPILE gate: steady-state recompiles mean
+    # unpadded shapes leak into XLA, and --recompile-ceiling (normally
+    # 0) turns that from invisible into a failing verdict.
+    from attendance_tpu.obs.exposition import (label_value,
+                                               rank_profile_stages)
+
+    prof: Dict[str, float] = {}
+    for name, labels, value in samples:
+        if name == "attendance_profile_stage_fraction":
+            try:
+                v = float(value)
+            except ValueError:
+                continue
+            if not math.isnan(v):
+                prof[label_value(labels, "stage") or ""] = v
+    if prof:
+        # One shared ranking (exposition.rank_profile_stages) with
+        # the fleet dashboard's top_stage cell: marked stages above
+        # the untagged remainder, so the two surfaces can never name
+        # different "top" stages for one run.
+        rows.append(["profiled top stages",
+                     ", ".join(f"{s} {v:.0%}" for s, v
+                               in rank_profile_stages(prof)),
+                     "-", "info"])
+    busy = []
+    for name, labels, value in samples:
+        if name == "attendance_dispatch_thread_busy_fraction":
+            try:
+                v = float(value)
+            except ValueError:
+                continue
+            if not math.isnan(v):
+                busy.append((label_value(labels, "component") or "",
+                             v))
+    if busy:
+        rows.append(["dispatch thread occupancy",
+                     ", ".join(f"{c} {v:.0%}"
+                               for c, v in sorted(busy)), "-", "info"])
+    gpairs = []
+    for name, labels, value in samples:
+        if name == "attendance_dispatch_gap_seconds_bucket":
+            le = _parse_le(labels)
+            if le is not None:
+                try:
+                    gpairs.append((le, float(value)))
+                except ValueError:
+                    continue
+    if gpairs and max(c for _, c in gpairs) > 0:
+        p50, p99 = quantiles_from_cumulative(gpairs, (0.50, 0.99))
+        rows.append(["dispatch gap p50/p99 (device idle window)",
+                     f"{_fmt_value(p50)}/{_fmt_value(p99)}", "-",
+                     "info"])
+    recomp = _vals("attendance_recompiles_total")
+    if recomp:
+        rows.append(["device recompiles (total, incl. warmup)",
+                     _fmt_value(sum(recomp)), "-", "info"])
+    steady = _vals("attendance_recompiles_steady_total")
+    if recompile_ceiling is not None:
+        # Like the merge-lag/watermark gates: a ceiling set for a run
+        # that never exported the tracker's counters FAILS loudly —
+        # vacuous passes hide exactly the storms this gate exists for.
+        worst = sum(steady) if steady else None
+        rows.append(["steady-state recompiles", _fmt_value(worst),
+                     f"<= {recompile_ceiling}",
+                     "FAIL" if worst is None
+                     or worst > recompile_ceiling else "PASS"])
+    elif steady and sum(steady) > 0:
+        rows.append(["steady-state recompiles (shape leak?)",
+                     _fmt_value(sum(steady)), "-", "info"])
     # Self-healing transport: reconnects are REMEDIATION (each one is
     # a survived outage), so the row is informational by default —
     # --max-reconnects turns it into a gate for runs that should have
@@ -849,7 +922,8 @@ def _quarantine_rows(directory: str) -> List[List[str]]:
 def _fleet_wide_rows(per_role_samples: Dict[str, list],
                      merge_lag_ceiling: Optional[float],
                      staleness_ceiling: Optional[float],
-                     watermark_lag_ceiling: Optional[float] = None
+                     watermark_lag_ceiling: Optional[float] = None,
+                     recompile_ceiling: Optional[int] = None
                      ) -> List[List[str]]:
     """Fleet-level rows judged over the MERGED data: merge-lag p99
     from the summed cumulative buckets across every artifact that has
@@ -926,6 +1000,30 @@ def _fleet_wide_rows(per_role_samples: Dict[str, list],
     elif lags:
         rows.append(["fleet: worst watermark lag",
                      _fmt_value(max(lags)), "-", "info"])
+    # Attribution plane: steady-state recompiles summed over every
+    # role that exports the tracker (dispatching roles); a ceiling
+    # over a fleet where NO role exported it fails loudly — and the
+    # only dispatching roles are exactly the ones that must export.
+    steadies = []
+    for samples in per_role_samples.values():
+        for name, _labels, v in samples:
+            if name != "attendance_recompiles_steady_total":
+                continue
+            try:
+                v = float(v)
+            except ValueError:
+                continue
+            if not math.isnan(v):
+                steadies.append(v)
+    if recompile_ceiling is not None:
+        worst = sum(steadies) if steadies else None
+        rows.append(["fleet: steady-state recompiles",
+                     _fmt_value(worst), f"<= {recompile_ceiling}",
+                     "FAIL" if worst is None
+                     or worst > recompile_ceiling else "PASS"])
+    elif steadies and sum(steadies) > 0:
+        rows.append(["fleet: steady-state recompiles (shape leak?)",
+                     _fmt_value(sum(steadies)), "-", "info"])
     rows.append(["fleet: SLO alerts firing across roles",
                  str(firing), "== 0",
                  "PASS" if firing == 0 else "FAIL"])
@@ -942,7 +1040,8 @@ def doctor_fleet_report(fleet_dir: str, *,
                         query_p99_ceiling: Optional[float] = None,
                         staleness_ceiling: Optional[float] = None,
                         merge_lag_ceiling: Optional[float] = None,
-                        watermark_lag_ceiling: Optional[float] = None
+                        watermark_lag_ceiling: Optional[float] = None,
+                        recompile_ceiling: Optional[int] = None
                         ) -> Tuple[str, bool]:
     """ONE verdict table over a fleet collector's artifact directory
     (``--fleet-dir``): every ``<role>@<instance>.prom`` the collector
@@ -977,7 +1076,8 @@ def doctor_fleet_report(fleet_dir: str, *,
             rows.append([f"{role}: {row[0]}", *row[1:]])
     rows.extend(_fleet_wide_rows(per_role_samples, merge_lag_ceiling,
                                  staleness_ceiling,
-                                 watermark_lag_ceiling))
+                                 watermark_lag_ceiling,
+                                 recompile_ceiling))
     trace_path = root / "fleet_trace.json"
     if trace_path.exists():
         doc = json.loads(trace_path.read_text())
@@ -1010,6 +1110,7 @@ def doctor_report(paths: Sequence[str], *,
                   staleness_ceiling: Optional[float] = None,
                   merge_lag_ceiling: Optional[float] = None,
                   watermark_lag_ceiling: Optional[float] = None,
+                  recompile_ceiling: Optional[int] = None,
                   quarantine_dir: str = ""
                   ) -> Tuple[str, bool]:
     """Replay run artifacts offline; returns (verdict text, ok).
@@ -1041,7 +1142,8 @@ def doctor_report(paths: Sequence[str], *,
                                      query_p99_ceiling,
                                      staleness_ceiling,
                                      merge_lag_ceiling,
-                                     watermark_lag_ceiling))
+                                     watermark_lag_ceiling,
+                                     recompile_ceiling))
         elif kind == "alerts":
             arows, traces = _alert_checks(payload)
             rows.extend(arows)

@@ -393,6 +393,65 @@ class FusedPipeline:
             self._h_device = self._obs.stage("device_wait")
             self._h_snap_write = self._obs.stage("snapshot_write")
             self._h_snap_blocked = self._obs.stage("snapshot_blocked")
+        # Attribution plane (obs/profiler.py, ISSUE 15). Three
+        # capture-once handles, each one `is not None` branch when
+        # off: _stage_mark lets the sampling profiler attribute every
+        # stack sample to the stage this thread is in (marked at the
+        # SAME transitions the stage histograms already time),
+        # _recomp is the jitted-dispatch shape-fingerprint tracker
+        # (recompile storms from unpadded shapes were invisible), and
+        # the dispatch-gap histogram records device idle between
+        # consecutive dispatch enqueues — the honest "device outruns
+        # transport" number.
+        self._recomp = (self._obs.recompiles if self._obs is not None
+                        else None)
+        prof = (self._obs.profiler if self._obs is not None else None)
+        self._stage_mark = prof.stages if prof is not None else None
+        self._h_gap = None
+        self._last_dispatch_t = 0.0
+        # Dispatch-thread occupancy split (ISSUE 14 carried item,
+        # measured instead of guessed): wall seconds this thread spent
+        # in decode / device dispatch / the temporal host passes /
+        # blocked on device results, since the current run() started.
+        # Exported as attendance_dispatch_thread_busy_fraction
+        # callback gauges — scrape-time division, zero hot-loop cost
+        # beyond the accumulations process_frame already times.
+        self._busy = {"decode": 0.0, "device_dispatch": 0.0,
+                      "temporal": 0.0, "device_wait": 0.0}
+        self._busy_anchor = time.perf_counter()
+        self._last_dequeue_s = 0.0  # run-loop receive wait, per batch
+        self._dw_accum = 0.0  # device_wait since the last flight rec
+        self._c_xfer: Dict[tuple, object] = {}
+        if self._obs is not None:
+            self._h_gap = self._obs.registry.histogram(
+                "attendance_dispatch_gap_seconds",
+                help="Host-side gap between consecutive device "
+                "dispatch enqueues (device idle opportunity: the "
+                "transport/host side is what fills it)")
+            import weakref
+            ref = weakref.ref(self)
+
+            def _busy_reader(component: str):
+                def read() -> float:
+                    pipe = ref()
+                    if pipe is None:
+                        return float("nan")
+                    wall = time.perf_counter() - pipe._busy_anchor
+                    return (pipe._busy[component] / wall
+                            if wall > 0 else 0.0)
+                return read
+
+            components = ("decode", "device_dispatch", "device_wait")
+            if getattr(self.config, "temporal_period_s", 0.0) > 0:
+                components += ("temporal",)
+            for component in components:
+                self._obs.registry.gauge(
+                    "attendance_dispatch_thread_busy_fraction",
+                    help="Dispatch-thread occupancy split since the "
+                    "current run started (the measurement behind the "
+                    "lane-style temporal-worker decision)",
+                    component=component).set_function(
+                        _busy_reader(component))
         self._last_wire = ""
         # Fault plane (chaos/): install the injector BEFORE transport
         # and store construction so both seams pick it up; None (the
@@ -704,6 +763,7 @@ class FusedPipeline:
     # -- roster -------------------------------------------------------------
     def preload(self, keys) -> None:
         keys = np.asarray(keys, dtype=np.uint32)
+        self._count_xfer("preload", "h2d", keys.nbytes)
         self._bloom_host = None  # invalidate the snapshot-path cache
         # The filter changed: any existing base snapshot no longer
         # covers it, so the next barrier must write a fresh full base
@@ -935,6 +995,7 @@ class FusedPipeline:
         kbuf[:n] = keys
         bbuf = np.full(padded, -1, np.int32)
         bbuf[:n] = banks
+        self._note_compile("temporal_window_add", padded)
         self.state = self.state._replace(hll_regs=self._t_add(
             self.state.hll_regs, self.state.bloom_bits,
             jax.numpy.asarray(kbuf), jax.numpy.asarray(bbuf)))
@@ -967,6 +1028,9 @@ class FusedPipeline:
     def process_frame(self, data: bytes):
         """Dispatch one bulk binary frame; returns the async validity."""
         obs_t = self._obs
+        st = self._stage_mark
+        if st is not None:
+            st.set("decode")
         t0 = time.perf_counter()
         # Skip the embedded ground-truth column: validity is recomputed
         # on device and the store gets the computed vector. The codec
@@ -975,9 +1039,21 @@ class FusedPipeline:
         # wires slot in as codecs, not hot-loop branches.
         cols = decode_frame(data, include_truth=False)
         t_dec = time.perf_counter() if obs_t is not None else 0.0
+        if st is not None:
+            st.set("dispatch")
         n = len(cols["student_id"])
         if n == 0:
             return None
+        if obs_t is not None and self._last_dispatch_t:
+            # Gap since the previous dispatch ENQUEUE completed: the
+            # window the device could have been starving in. Host-side
+            # by necessity, but dispatches are async (the device runs
+            # behind the queue), so queue-feed gaps ARE the ceiling.
+            # After the empty-frame return: an n == 0 frame dispatches
+            # nothing, and observing its arrival would double-count
+            # the same idle window against the next real frame.
+            self._h_gap.observe(max(t_dec - self._last_dispatch_t,
+                                    0.0))
         if self._snap_dirty:
             # Delta checkpointing: note which lecture days this frame
             # touches (barriers map them to dirty HLL banks). One
@@ -1022,11 +1098,15 @@ class FusedPipeline:
                         self._count_wire("word")
                         words = pack_words(sid, banks, kw,
                                            self.engine.padded_size(n))
+                        self._note_compile("sharded_step_words", kw,
+                                           len(words))
                         valid_n = self.engine.step_words(words, n, kw)
                     else:
                         # Separate key/bank/mask arrays (9 B/event).
                         self._note_word_degrade()
                         self._count_wire("arrays")
+                        self._note_compile("sharded_step_arrays",
+                                           self.engine.padded_size(n))
                         valid_n = self.engine.step(sid, banks)
                 stored = valid_n
         else:
@@ -1053,11 +1133,24 @@ class FusedPipeline:
             # the zero-copy views; this copies only the narrow stored
             # columns, off the wire's critical path.)
             cols = {k: np.array(v) for k, v in cols.items()}
+        t_tmp = 0.0
         if self._temporal is not None:
             # Temporal sidecar: windowed adds dispatch with this
             # frame (order-free scatter-max, same ack barrier); the
             # reorder stage feeds the order-sensitive consumers.
-            self._temporal.observe_frame(cols)
+            # Timed separately when telemetry is on — the dispatch-
+            # thread busy-fraction gauge splits device dispatch from
+            # these host passes (the lane-worker decision's number).
+            if obs_t is None:
+                self._temporal.observe_frame(cols)
+            else:
+                if st is not None:
+                    st.set("temporal")
+                t_tmp0 = time.perf_counter()
+                self._temporal.observe_frame(cols)
+                t_tmp = time.perf_counter() - t_tmp0
+                if st is not None:
+                    st.set("dispatch")
         self.store.insert_columns({**cols, "is_valid": stored})
         self.metrics.batches += 1
         self.metrics.events += n
@@ -1067,6 +1160,13 @@ class FusedPipeline:
         if obs_t is not None:
             self._h_decode.observe(t_dec - t0)
             self._h_dispatch.observe(t_end - t_dec)
+            self._last_dispatch_t = t_end
+            # Occupancy split feeding the busy-fraction gauges: the
+            # temporal host passes are carved OUT of the dispatch
+            # phase they currently ride inside.
+            self._busy["decode"] += t_dec - t0
+            self._busy["temporal"] += t_tmp
+            self._busy["device_dispatch"] += (t_end - t_dec) - t_tmp
             obs_t.events.inc(n)
             obs_t.frames.inc()
             trace_hex = ""
@@ -1090,6 +1190,21 @@ class FusedPipeline:
                 decode_s=round(t_dec - t0, 6),
                 dispatch_s=round(t_end - t_dec, 6),
                 inflight=len(self._inflight))
+            # Per-record stage self-times (ISSUE 15 satellite): a
+            # SIGUSR1 dump is attributable on its own — dequeue wait
+            # from the run loop, decode/dispatch from this frame,
+            # device_wait accumulated from the drains since the last
+            # record — without needing the separate trace file.
+            dw, self._dw_accum = self._dw_accum, 0.0
+            stages = {
+                "dequeue_wait": round(self._last_dequeue_s, 6),
+                "decode": round(t_dec - t0, 6),
+                "dispatch": round((t_end - t_dec) - t_tmp, 6),
+                "device_wait": round(dw, 6),
+            }
+            if self._temporal is not None:
+                stages["temporal"] = round(t_tmp, 6)
+            rec["stages"] = stages
             if trace_hex:
                 # Cross-reference: a flight-recorder dump names the
                 # trace each batch record belongs to, so wedged-run
@@ -1263,10 +1378,13 @@ class FusedPipeline:
                     if use_words:
                         self._kw_hint = kw
                         self._count_wire("word")
+                        self._note_compile("step_words", kw,
+                                           len(words))
                         self.state, valid = self._word_step(kw)(
                             self.state, jax.numpy.asarray(words))
                     else:
                         self._count_wire("bytes")
+                        self._note_compile("step_bytes", len(words))
                         self.state, valid = self._step(
                             self.state, jax.numpy.asarray(words))
                     return valid, None
@@ -1303,6 +1421,7 @@ class FusedPipeline:
             self._kw_hint = kw
             self._count_wire("word")
             words = pack_words(sid, banks, kw, padded)
+            self._note_compile("step_words", kw, len(words))
             self.state, valid = self._word_step(kw)(
                 self.state, jax.numpy.asarray(words))
             return valid, None
@@ -1312,6 +1431,7 @@ class FusedPipeline:
         self._note_word_degrade()
         self._count_wire("bytes")
         buf = pack_bytes(sid, banks, self._bank_dtype, padded)
+        self._note_compile("step_bytes", len(buf))
         self.state, valid = self._step(self.state, jax.numpy.asarray(buf))
         return valid, None
 
@@ -1424,6 +1544,8 @@ class FusedPipeline:
         if self._obs is not None:
             engine.note_shard_events(
                 [bounds[r + 1] - bounds[r] for r in range(dp)])
+        self._note_compile(f"sharded_step_{mode}", width,
+                           padded_local)
         valid = engine.step_narrow(bufs, mode, width, padded_local)
         return valid, lanes, orig
 
@@ -1454,6 +1576,33 @@ class FusedPipeline:
         self._last_wire = key
         if self._obs is not None:
             self._obs.wire(key).inc()
+
+    def _note_compile(self, fn: str, *fingerprint) -> None:
+        """Report one jitted dispatch's shape fingerprint to the
+        recompile tracker (obs/profiler.RecompileTracker) — called at
+        the dispatch sites themselves, like _count_wire, so the
+        fingerprint describes the program variant that actually ran.
+        Cost per frame: one set lookup; a NEW fingerprint is exactly
+        one XLA trace+compile."""
+        rc = self._recomp
+        if rc is not None:
+            rc.observe(fn, fingerprint)
+
+    def _count_xfer(self, site: str, direction: str,
+                    nbytes: int) -> None:
+        """Count host<->device bytes at the gather seams (snapshot
+        capture D2H, mirror gather D2H, roster preload H2D) —
+        attendance_device_transfer_bytes_total{site=,direction=}."""
+        if self._obs is None or nbytes <= 0:
+            return
+        key = (site, direction)
+        c = self._c_xfer.get(key)
+        if c is None:
+            c = self._c_xfer[key] = self._obs.registry.counter(
+                "attendance_device_transfer_bytes_total",
+                help="Host<->device bytes moved at the snapshot/"
+                "mirror gather seams", site=site, direction=direction)
+        c.inc(int(nbytes))
 
     def _auto_wire(self) -> str:
         """Per-frame wire choice for auto mode, from observed
@@ -1544,6 +1693,8 @@ class FusedPipeline:
                         step = self._delta_step(width, padded,
                                                 num_banks)
                     self._count_wire(mode)
+                    self._note_compile(f"step_{mode}", width, padded,
+                                       num_banks)
                     self.state, valid = step(self.state,
                                              jax.numpy.asarray(buf))
                     return valid, perm, None
@@ -1588,6 +1739,9 @@ class FusedPipeline:
                                    scan=scan)
             step = self._delta_step(db, padded, num_banks)
         self._count_wire(mode)
+        self._note_compile(f"step_{mode}",
+                           kb if mode == "seg" else db, padded,
+                           num_banks)
         self.state, valid = step(self.state, jax.numpy.asarray(buf))
         return valid, perm, None
 
@@ -1952,6 +2106,8 @@ class FusedPipeline:
     def _run_snap_job_logged(self, job: dict) -> None:
         t0 = time.perf_counter()
         inj = self._chaos
+        st = self._stage_mark
+        prev_stage = st.set("snapshot") if st is not None else None
         try:
             if inj is not None:
                 stall = inj.stall_s("snapshot.writer")
@@ -2014,6 +2170,8 @@ class FusedPipeline:
                     "failures: %d, next attempt in %.2fs)",
                     self._snap_fail_streak, self._writer_backoff_s())
         finally:
+            if st is not None:
+                st.restore(prev_stage)
             t_done = time.perf_counter()
             stall = t_done - t0
             self.metrics.snapshot_stalls.append(stall)
@@ -2035,6 +2193,9 @@ class FusedPipeline:
             regs_h, counts_h = jax.device_get(
                 (job["regs"], job["counts"]))
             regs_h = np.asarray(regs_h)
+            self._count_xfer("snapshot_capture", "d2h",
+                             regs_h.nbytes
+                             + np.asarray(counts_h).nbytes)
             with self._snap_io_lock:
                 self._write_snapshot_files(
                     job["bloom"], regs_h, counts_h, job["bank_of"],
@@ -2059,6 +2220,9 @@ class FusedPipeline:
                 "barrier writes a full base")
         banks = job["banks"]
         rows_h, counts_h = jax.device_get((job["rows"], job["counts"]))
+        self._count_xfer("snapshot_capture", "d2h",
+                         np.asarray(rows_h).nbytes
+                         + np.asarray(counts_h).nbytes)
         rows_h = np.asarray(rows_h)[:len(banks)]
         with self._snap_io_lock:
             nbytes = self._write_delta_files(
@@ -2099,11 +2263,17 @@ class FusedPipeline:
             bits, regs = self.engine.get_state()
             counts = self.engine.get_counts()
             self._bloom_host = np.asarray(bits)
-            return np.asarray(regs, dtype=np.uint8), counts
+            regs_h = np.asarray(regs, dtype=np.uint8)
+            self._count_xfer("mirror_gather", "d2h",
+                             self._bloom_host.nbytes + regs_h.nbytes)
+            return regs_h, counts
         if self._bloom_host is None:
             self._bloom_host = np.asarray(self.state.bloom_bits)
-        return (np.asarray(self.state.hll_regs),
-                np.asarray(self.state.counts))
+            self._count_xfer("mirror_gather", "d2h",
+                             self._bloom_host.nbytes)
+        regs_h = np.asarray(self.state.hll_regs)
+        self._count_xfer("mirror_gather", "d2h", regs_h.nbytes)
+        return regs_h, np.asarray(self.state.counts)
 
     def publish_epoch(self) -> None:
         """Force one synchronous epoch publish from the CURRENT device
@@ -2204,6 +2374,7 @@ class FusedPipeline:
                 from attendance_tpu.models.fused import (
                     make_jitted_snapshot_capture)
                 self._snap_take = make_jitted_snapshot_capture()
+            self._note_compile("snapshot_capture", len(idx))
             rows_c, counts_c = self._snap_take(self.state.hll_regs,
                                                jax.numpy.asarray(idx),
                                                self.state.counts)
@@ -2667,6 +2838,8 @@ class FusedPipeline:
         rows = self.engine.get_state_rows(
             self._pad_bank_index(banks))[:len(banks)]
         counts = self.engine.get_counts()
+        self._count_xfer("snapshot_capture", "d2h",
+                         np.asarray(rows).nbytes)
         self._batches_at_snap = self.metrics.batches
         if jax.process_count() > 1 and jax.process_index() != 0:
             return
@@ -2711,10 +2884,17 @@ class FusedPipeline:
                     if self._obs is None:
                         jax.block_until_ready(valid)
                     else:
+                        st = self._stage_mark
+                        prev_stage = (st.set("device_wait")
+                                      if st is not None else None)
                         t_w = time.perf_counter()
                         jax.block_until_ready(valid)
                         t_done = time.perf_counter()
+                        if st is not None:
+                            st.restore(prev_stage)
                         self._h_device.observe(t_done - t_w)
+                        self._busy["device_wait"] += t_done - t_w
+                        self._dw_accum += t_done - t_w
                         if self._tracer is not None and span is not None:
                             # device_wait lands AFTER its batch span
                             # closed (pipelining) — committed with
@@ -2732,6 +2912,16 @@ class FusedPipeline:
     def run(self, max_events: Optional[int] = None,
             idle_timeout_s: float = 1.0) -> None:
         t_start = time.perf_counter()
+        # The busy-fraction gauges describe the CURRENT run: reset the
+        # split so an idle gap between runs doesn't dilute it. The
+        # dispatch-gap cursor resets for the same reason — the first
+        # frame of a later run must not record the whole inter-run
+        # idle as one giant "gap", which would own the p99 forever.
+        self._busy_anchor = t_start
+        for k in self._busy:
+            self._busy[k] = 0.0
+        self._last_dispatch_t = 0.0
+        self._last_dequeue_s = 0.0
         idle_since = time.monotonic()
         try:
             with maybe_trace(self.config.profile_dir):
@@ -2777,6 +2967,13 @@ class FusedPipeline:
             # judge its objectives (and log any firing alert).
             self._obs.finalize_slo("run-end")
             self._obs.flush_trace("run-end")
+            self._obs.flush_profile("run-end")
+            if self._recomp is not None:
+                # Steady-state contract: warmup compiles end with the
+                # first completed run loop — any NEW shape fingerprint
+                # after this is a recompile leak doctor's
+                # --recompile-ceiling gates at 0.
+                self._recomp.mark_warm()
 
     def _begin_batch_span(self, msg, t_rx: float, t_got: float):
         """Per-batch span continuing the propagated trace; redelivered
@@ -2794,8 +2991,11 @@ class FusedPipeline:
 
     def _run_loop(self, max_events: Optional[int],
                   idle_timeout_s: float, idle_since: float) -> None:
+        st = self._stage_mark
         while True:
             try:
+                if st is not None:
+                    st.set("dequeue")
                 if self._obs is None:
                     msg = self.consumer.receive(timeout_millis=50)
                 else:
@@ -2803,6 +3003,7 @@ class FusedPipeline:
                     msg = self.consumer.receive(timeout_millis=50)
                     t_got = time.perf_counter()
                     self._h_dequeue.observe(t_got - t_rx)
+                    self._last_dequeue_s = t_got - t_rx
             except ReceiveTimeout:
                 if self._temporal is not None:
                     # Watermark idle advancement: a silent stream

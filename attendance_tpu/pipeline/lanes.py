@@ -198,6 +198,11 @@ class IngressLane:
                 lane=lane).set_function(lambda q=q: float(len(q)))
         else:
             self._c_events = None
+        # Sampling-profiler stage mark (obs/profiler.py): a lane
+        # worker is single-purpose, so _run marks it once (sticky).
+        prof = getattr(obs, "profiler", None) if obs is not None \
+            else None
+        self._stage_mark = prof.stages if prof is not None else None
         self.thread = threading.Thread(
             target=self._run, name=f"ingress-lane-{index}", daemon=True)
 
@@ -299,6 +304,8 @@ class IngressLane:
         return chunks
 
     def _run(self) -> None:
+        if self._stage_mark is not None:
+            self._stage_mark.set("lane_decode")
         while not self._stop.is_set():
             self._drain_settlements()
             chunks = None

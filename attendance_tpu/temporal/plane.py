@@ -93,6 +93,11 @@ class TemporalPlane:
             {} if getattr(config, "audit_sample", 0.0) >= 1.0 else None)
         self._roster: Optional[np.ndarray] = None
         self._obs = obs
+        # Attribution plane (obs/profiler.py): the CMS step is a
+        # jitted entry point too — its padded-shape fingerprints ride
+        # the same recompile tracker as the fused steps, so a CMS
+        # recompile storm is as visible as a dispatch one.
+        self._recomp = (obs.recompiles if obs is not None else None)
         self._c_late = {}
         if obs is not None:
             reg = obs.registry
@@ -237,6 +242,8 @@ class TemporalPlane:
         step = self._cms_steps.get(padded)
         if step is None:
             step = self._cms_steps[padded] = make_jitted_cms_step()
+        if self._recomp is not None:
+            self._recomp.observe("cms_step", (padded,))
         import jax.numpy as jnp
         self._cms, est = step(self._cms, jnp.asarray(kbuf),
                               jnp.asarray(mask))

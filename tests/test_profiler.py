@@ -1,0 +1,535 @@
+"""Continuous profiling & attribution plane tests (ISSUE 15).
+
+Covers: collapsed-stack correctness on a synthetic two-thread
+workload, sampler start/stop hygiene (no leaked thread, no samples
+after close), recompile-tracker semantics (once per new shape
+fingerprint, zero in steady state, warm contract), the attribution
+table golden file, the trend gate's attribution diff on a synthetic
+regression pair, the doctor --recompile-ceiling / dispatch-gap /
+busy-fraction rows, the fleet headline's top-stage cell, and one
+end-to-end fused run with the profiler live (flight-record stage
+self-times, gap histogram, artifacts, telemetry --attribution).
+"""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from attendance_tpu.obs.profiler import (
+    ATTRIBUTION_FILE, FOLDED_FILE, TRACE_FILE, RecompileTracker,
+    SamplingProfiler, StageTracker, format_attribution_table)
+from attendance_tpu.obs.registry import Registry
+
+REPO = Path(__file__).resolve().parent.parent
+DATA = Path(__file__).resolve().parent / "data"
+
+
+# -- stage tracker -----------------------------------------------------------
+
+def test_stage_tracker_set_restore_nesting():
+    st = StageTracker()
+    ident = threading.get_ident()
+    assert st.get(ident) is None
+    prev = st.set("dispatch")
+    assert prev is None
+    assert st.get(ident) == "dispatch"
+    prev2 = st.set("device_wait")
+    assert prev2 == "dispatch"
+    st.restore(prev2)
+    assert st.get(ident) == "dispatch"
+    st.restore(prev)
+    assert st.get(ident) is None
+
+
+# -- sampling correctness ----------------------------------------------------
+
+def test_stage_tracker_prunes_dead_thread_marks():
+    """CPython recycles thread idents: a dead thread's sticky mark
+    must not survive to mislabel whichever thread inherits it."""
+    prof = SamplingProfiler(50)
+    t = threading.Thread(target=lambda: prof.stages.set("serve"))
+    t.start()
+    t.join()
+    ident = t.ident
+    assert prof.stages.get(ident) == "serve"
+    prof.sample_once()  # prunes idents absent from _current_frames
+    assert prof.stages.get(ident) is None
+
+
+def _spin_alpha_workload(stop, tracker):
+    tracker.set("alpha")
+    while not stop.is_set():
+        sum(i for i in range(200))
+
+
+def _spin_beta_workload(stop, tracker):
+    tracker.set("beta")
+    while not stop.is_set():
+        sum(i * i for i in range(200))
+
+
+def test_collapsed_stacks_two_thread_workload():
+    """Two threads spinning in distinctively named functions, each
+    marked with its own stage: the collapsed stacks must attribute
+    each function to ITS thread's stage — never cross them."""
+    prof = SamplingProfiler(97)
+    stop = threading.Event()
+    threads = [
+        threading.Thread(target=_spin_alpha_workload,
+                         args=(stop, prof.stages),
+                         name="alpha-worker", daemon=True),
+        threading.Thread(target=_spin_beta_workload,
+                         args=(stop, prof.stages),
+                         name="beta-worker", daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    try:
+        # Drive the sampler deterministically (no background thread):
+        # every sample sees both workers mid-spin.
+        for _ in range(50):
+            prof.sample_once()
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    collapsed = prof.collapsed()
+    alpha_lines = [ln for ln in collapsed.splitlines()
+                   if "_spin_alpha_workload" in ln]
+    beta_lines = [ln for ln in collapsed.splitlines()
+                  if "_spin_beta_workload" in ln]
+    assert alpha_lines and beta_lines
+    # Stage attribution is per thread: alpha frames carry stage
+    # "alpha" on the alpha-worker role, and never stage "beta".
+    assert all(ln.startswith("alpha-worker;alpha;")
+               for ln in alpha_lines), alpha_lines
+    assert all(ln.startswith("beta-worker;beta;")
+               for ln in beta_lines), beta_lines
+    # Every line is "stack count" with a positive count, and both
+    # stages got a meaningful share of the samples.
+    for ln in collapsed.splitlines():
+        assert int(ln.rsplit(" ", 1)[1]) > 0
+    att = prof.attribution()
+    assert att["stages"]["alpha"]["samples"] >= 10
+    assert att["stages"]["beta"]["samples"] >= 10
+    assert att["threads"]["alpha-worker"]["alpha"] \
+        == att["stages"]["alpha"]["samples"]
+
+
+def test_sampler_start_stop_hygiene():
+    """No leaked thread after stop, and no samples folded after."""
+    prof = SamplingProfiler(211)
+    prof.start()
+    deadline = time.time() + 5.0
+    while prof.samples == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    assert prof.samples > 0
+    prof.stop()
+    assert not prof.running
+    assert not [t for t in threading.enumerate()
+                if t.name == "attendance-profiler"]
+    frozen = prof.samples
+    time.sleep(3.0 / 211 + 0.05)  # three would-be sampling periods
+    assert prof.samples == frozen
+    prof.stop()  # idempotent
+
+
+def test_chrome_trace_merges_consecutive_same_stage_samples():
+    prof = SamplingProfiler(97, _clock=time.perf_counter)
+    stop = threading.Event()
+    t = threading.Thread(target=_spin_alpha_workload,
+                         args=(stop, prof.stages),
+                         name="alpha-worker", daemon=True)
+    t.start()
+    try:
+        for _ in range(10):
+            prof.sample_once()
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        t.join()
+    doc = prof.chrome_trace()
+    slices = [e for e in doc["traceEvents"]
+              if e.get("ph") == "X" and e["name"] == "alpha"]
+    # 10 consecutive same-stage samples merge into ONE open slice.
+    assert len(slices) == 1
+    assert doc["otherData"]["samples"] >= 10
+
+
+def test_stage_fraction_gauges_ride_the_registry():
+    reg = Registry()
+    prof = SamplingProfiler(50, registry=reg)
+    stop = threading.Event()
+    t = threading.Thread(target=_spin_alpha_workload,
+                         args=(stop, prof.stages),
+                         name="alpha-worker", daemon=True)
+    t.start()
+    try:
+        for _ in range(5):
+            prof.sample_once()
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        t.join()
+    from attendance_tpu.obs.exposition import render
+    text = render(reg)
+    assert "attendance_profile_samples_total" in text
+    assert 'attendance_profile_stage_fraction{stage="alpha"}' in text
+
+
+# -- recompile tracker -------------------------------------------------------
+
+def test_recompile_tracker_fires_once_per_fingerprint():
+    reg = Registry()
+    rc = RecompileTracker(reg)
+    assert rc.observe("step_words", (20, 4096)) is True
+    # Steady state: the same fingerprint never fires again.
+    for _ in range(100):
+        assert rc.observe("step_words", (20, 4096)) is False
+    assert rc.observe("step_words", (20, 8192)) is True
+    assert rc.observe("step_bytes", (4096,)) is True
+    assert rc.total == 3
+    assert rc.steady == 0
+    rc.mark_warm()
+    assert rc.observe("step_words", (20, 4096)) is False  # known
+    assert rc.observe("step_words", (21, 4096)) is True  # leak!
+    assert rc.total == 4
+    assert rc.steady == 1
+    snap = rc.snapshot()
+    assert snap["total"] == 4 and snap["steady"] == 1
+    assert any(fp["steady"] for fp in snap["fingerprints"])
+    counters = {(m.name, m.labels): m.value
+                for _, _, _, members in reg.collect()
+                for m in members}
+    assert counters[("attendance_recompiles_total",
+                     (("fn", "step_words"),))] == 3
+    assert counters[("attendance_recompiles_steady_total",
+                     (("fn", "step_words"),))] == 1
+    assert counters[("attendance_recompiles_steady_total",
+                     (("fn", "step_bytes"),))] == 0
+
+
+# -- attribution table golden ------------------------------------------------
+
+GOLDEN_DOC = {
+    "kind": "attribution", "pid": 7, "hz": 29.0,
+    "samples_total": 200, "duration_s": 4.0,
+    "stages": {"decode": {"samples": 60, "frac": 0.3},
+               "dispatch": {"samples": 120, "frac": 0.6},
+               "untagged": {"samples": 20, "frac": 0.1}},
+    "threads": {"MainThread": {"decode": 60, "dispatch": 120},
+                "snapshot-writer": {"untagged": 20}},
+    "recompiles": {"total": 3, "steady": 1, "fingerprints": [
+        {"fn": "step_words", "fingerprint": [20, 4096],
+         "steady": False},
+        {"fn": "step_words", "fingerprint": [20, 8192],
+         "steady": True},
+    ]},
+}
+
+
+def test_attribution_table_golden():
+    rendered = format_attribution_table(GOLDEN_DOC)
+    golden = (DATA / "attribution_table.golden").read_text()
+    assert rendered == golden.rstrip("\n"), (
+        "attribution table drifted from tests/data/"
+        "attribution_table.golden:\n" + rendered)
+
+
+def test_attribution_sniffed_by_format_file(tmp_path):
+    from attendance_tpu.obs.exposition import format_file
+
+    p = tmp_path / "attribution.json"
+    p.write_text(json.dumps(GOLDEN_DOC))
+    out = format_file(str(p))
+    assert "dispatch" in out and "60.0%" in out
+
+
+# -- trend gate attribution diff ---------------------------------------------
+
+HOST = {"cpu_count": 2, "device_kind": "cpu",
+        "device_platform": "cpu", "num_devices": 1,
+        "platform": "test", "python": "3.10"}
+
+
+def _obs_artifact(value: float, stages: dict, recompiles=None) -> dict:
+    return {
+        "metric": "obs_overhead", "value": 0.01, "unit": "fraction",
+        "disabled_events_per_sec": value, "host": HOST,
+        "attribution": {"hz": 29.0, "samples": 1000,
+                        "stages": stages,
+                        "recompiles": recompiles
+                        or {"total": 2, "steady": 0},
+                        "dispatch_gap": {"p50_s": 1e-4,
+                                         "p99_s": 2e-3}},
+    }
+
+
+def test_trend_gate_names_injected_stage_delta(tmp_path):
+    import sys
+    sys.path.insert(0, str(REPO / "tools"))
+    import bench_trend
+
+    (tmp_path / "BENCH_OBS_r01.json").write_text(json.dumps(
+        _obs_artifact(1_000_000.0,
+                      {"dispatch": 0.30, "decode": 0.10,
+                       "untagged": 0.60})))
+    # -20% regression with the time moving INTO dispatch (and a
+    # recompile growth — the classic silent cause).
+    (tmp_path / "BENCH_OBS_r02.json").write_text(json.dumps(
+        _obs_artifact(800_000.0,
+                      {"dispatch": 0.52, "decode": 0.08,
+                       "untagged": 0.40},
+                      recompiles={"total": 9, "steady": 7})))
+    text, ok = bench_trend.run_gate(
+        sorted(tmp_path.glob("BENCH*.json")), 0.10)
+    assert not ok
+    assert "top stage deltas" in text
+    assert "dispatch +22.0pp" in text
+    assert "recompiles 2->9" in text
+
+
+def test_trend_gate_attribution_silent_on_pass(tmp_path):
+    import sys
+    sys.path.insert(0, str(REPO / "tools"))
+    import bench_trend
+
+    (tmp_path / "BENCH_OBS_r01.json").write_text(json.dumps(
+        _obs_artifact(1_000_000.0, {"dispatch": 0.30})))
+    (tmp_path / "BENCH_OBS_r02.json").write_text(json.dumps(
+        _obs_artifact(990_000.0, {"dispatch": 0.31})))
+    text, ok = bench_trend.run_gate(
+        sorted(tmp_path.glob("BENCH*.json")), 0.10)
+    assert ok
+    assert "top stage deltas" not in text
+
+
+# -- doctor rows -------------------------------------------------------------
+
+PROM_WITH_ATTRIBUTION = """\
+# TYPE attendance_recompiles_total counter
+attendance_recompiles_total{fn="step_words"} 3
+# TYPE attendance_recompiles_steady_total counter
+attendance_recompiles_steady_total{fn="step_words"} %(steady)s
+# TYPE attendance_profile_stage_fraction gauge
+attendance_profile_stage_fraction{stage="dispatch"} 0.42
+attendance_profile_stage_fraction{stage="decode"} 0.11
+# TYPE attendance_dispatch_thread_busy_fraction gauge
+attendance_dispatch_thread_busy_fraction{component="device_dispatch"} 0.5
+attendance_dispatch_thread_busy_fraction{component="temporal"} 0.3
+# TYPE attendance_dispatch_gap_seconds histogram
+attendance_dispatch_gap_seconds_bucket{le="0.000128"} 10
+attendance_dispatch_gap_seconds_bucket{le="+Inf"} 12
+attendance_dispatch_gap_seconds_sum 0.01
+attendance_dispatch_gap_seconds_count 12
+"""
+
+
+def _doctor(tmp_path, prom_text, **kwargs):
+    from attendance_tpu.obs.slo import doctor_report
+
+    p = tmp_path / "m.prom"
+    p.write_text(prom_text)
+    return doctor_report([str(p)], **kwargs)
+
+
+def test_doctor_recompile_ceiling_gate(tmp_path):
+    text, ok = _doctor(tmp_path, PROM_WITH_ATTRIBUTION % {"steady": 0},
+                       recompile_ceiling=0)
+    assert ok, text
+    assert "steady-state recompiles" in text
+    text, ok = _doctor(tmp_path, PROM_WITH_ATTRIBUTION % {"steady": 2},
+                       recompile_ceiling=0)
+    assert not ok
+    assert "steady-state recompiles" in text
+
+
+def test_doctor_recompile_ceiling_fails_loudly_when_absent(tmp_path):
+    # A ceiling over a run whose telemetry never exported the tracker
+    # must FAIL (vacuous-pass refusal, the merge-lag precedent).
+    text, ok = _doctor(
+        tmp_path, "# TYPE attendance_events_total counter\n"
+        "attendance_events_total 5\n"
+        "# TYPE attendance_slo_firing gauge\n",
+        recompile_ceiling=0)
+    assert not ok
+    assert "steady-state recompiles" in text
+
+
+def test_doctor_attribution_info_rows(tmp_path):
+    text, ok = _doctor(tmp_path, PROM_WITH_ATTRIBUTION % {"steady": 3})
+    assert ok, text  # no ceiling: info rows only
+    assert "profiled top stages" in text
+    assert "dispatch 42%" in text
+    assert "dispatch thread occupancy" in text
+    assert "temporal 30%" in text
+    assert "dispatch gap p50/p99" in text
+    assert "device recompiles (total, incl. warmup)" in text
+    assert "steady-state recompiles (shape leak?)" in text
+
+
+def test_doctor_fleet_recompile_gate(tmp_path):
+    from attendance_tpu.obs.slo import doctor_fleet_report
+
+    fleet = tmp_path / "fleet"
+    fleet.mkdir()
+    (fleet / "worker@w0.prom").write_text(
+        PROM_WITH_ATTRIBUTION % {"steady": 0})
+    (fleet / "serve@s0.prom").write_text(
+        "# TYPE attendance_events_total counter\n"
+        "attendance_events_total 5\n")
+    text, ok = doctor_fleet_report(str(fleet), recompile_ceiling=0)
+    assert ok, text
+    assert "fleet: steady-state recompiles" in text
+    (fleet / "worker@w0.prom").write_text(
+        PROM_WITH_ATTRIBUTION % {"steady": 4})
+    text, ok = doctor_fleet_report(str(fleet), recompile_ceiling=0)
+    assert not ok
+
+
+# -- fleet headline ----------------------------------------------------------
+
+def test_fleet_headline_top_stage():
+    from attendance_tpu.obs.fleet import _headline
+
+    out = _headline(PROM_WITH_ATTRIBUTION % {"steady": 0})
+    assert out["top_stage"] == "dispatch 42%"
+
+
+# -- end to end: fused run under the profiler --------------------------------
+
+@pytest.fixture
+def obs_off():
+    from attendance_tpu import obs
+
+    obs.disable()
+    yield
+    obs.disable()
+
+
+def test_fused_run_profiled_end_to_end(tmp_path, obs_off, capsys):
+    from attendance_tpu import obs
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+
+    prof_dir = tmp_path / "profile"
+    # json_chunk_decode off: the chunk consumer coalesces backlog
+    # frames into timing-dependent padded shapes — legitimate new
+    # programs, not the leak class the steady-recompile assert gates.
+    cfg = Config(profile_hz=97, profile_out=str(prof_dir),
+                 flight_recorder=32, json_chunk_decode=False)
+    t = obs.enable(cfg)
+    pipe = FusedPipeline(cfg)
+    try:
+        roster, frames = generate_frames(
+            24_576, 4096, roster_size=8_000, num_lectures=4, seed=3)
+        pipe.preload(roster)
+        producer = pipe.client.create_producer(cfg.pulsar_topic)
+        for f in frames:
+            producer.send(f)
+        pipe.run(max_events=24_576, idle_timeout_s=0.5)
+        # Warmup compiled something; nothing after run 1 may.
+        assert t.recompiles.total > 0
+        assert t.recompiles.warm
+        steady_before = t.recompiles.steady
+        # SAME seed: a different seed's roster can change the max-key
+        # bit width — a genuinely new program, not the leak class this
+        # asserts on (idempotent sketches make the replay harmless).
+        _, frames2 = generate_frames(
+            24_576, 4096, roster_size=8_000, num_lectures=4, seed=3)
+        for f in frames2:
+            producer.send(f)
+        pipe.run(max_events=49_152, idle_timeout_s=0.5)
+        assert t.recompiles.steady == steady_before == 0
+        # Flight records carry per-stage self-times (SIGUSR1
+        # attributability without the trace file).
+        rec = t.flight.snapshot()[-1]
+        stages = rec["stages"]
+        for key in ("dequeue_wait", "decode", "dispatch",
+                    "device_wait"):
+            assert key in stages
+        assert stages["decode"] >= 0.0
+        # Dispatch-gap histogram observed between frames.
+        gap = t.registry.histogram("attendance_dispatch_gap_seconds")
+        assert gap.count > 0
+        # Busy-fraction gauges render (decode/device_dispatch/
+        # device_wait; no temporal component without the plane).
+        text = t.render()
+        assert ('attendance_dispatch_thread_busy_fraction'
+                '{component="device_dispatch"}') in text
+        assert 'component="temporal"' not in text
+        assert "attendance_device_transfer_bytes_total" in text
+        assert "attendance_recompiles_steady_total" in text
+    finally:
+        pipe.cleanup()
+        obs.disable()
+    # Artifacts written at stop; the CLI renders the table.
+    for name in (FOLDED_FILE, TRACE_FILE, ATTRIBUTION_FILE):
+        assert (prof_dir / name).exists(), name
+    doc = json.loads((prof_dir / ATTRIBUTION_FILE).read_text())
+    assert doc["kind"] == "attribution"
+    assert doc["samples_total"] > 0
+    assert doc["recompiles"]["total"] > 0
+    from attendance_tpu import cli
+
+    cli.main(["telemetry", str(prof_dir), "--attribution"])
+    out = capsys.readouterr().out
+    assert "attribution:" in out and "stage" in out
+    assert "recompiles:" in out
+
+
+def test_telemetry_attribution_missing_artifact_exits_2(tmp_path):
+    from attendance_tpu import cli
+
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["telemetry", str(tmp_path / "nope"),
+                  "--attribution"])
+    assert exc.value.code == 2
+
+
+def test_profile_out_without_hz_is_a_config_error(tmp_path):
+    from attendance_tpu.config import Config
+
+    with pytest.raises(ValueError, match="profile-hz"):
+        Config(profile_out=str(tmp_path)).validate()
+    Config(profile_out=str(tmp_path), profile_hz=29).validate()
+
+
+def test_run_resets_dispatch_gap_cursor(obs_off):
+    """The inter-run idle must never land in the gap histogram: a
+    later run's first frame would otherwise record minutes of wall
+    clock as one 'dispatch gap' and own the p99 forever."""
+    from attendance_tpu import obs
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+
+    cfg = Config(flight_recorder=8)
+    obs.enable(cfg)
+    pipe = FusedPipeline(cfg)
+    try:
+        pipe._last_dispatch_t = 123.0  # stale cursor from a past run
+        pipe.run(max_events=0, idle_timeout_s=0.05)  # empty broker
+        assert pipe._last_dispatch_t == 0.0
+    finally:
+        pipe.cleanup()
+        obs.disable()
+
+
+def test_doctor_top_stages_rank_tagged_above_untagged(tmp_path):
+    """Same ordering as the fleet dashboard's top_stage cell: a
+    sample-heavy untagged bucket must not displace real stages."""
+    prom = (PROM_WITH_ATTRIBUTION % {"steady": 0}
+            + 'attendance_profile_stage_fraction{stage="untagged"}'
+            + " 0.9\n")
+    text, ok = _doctor(tmp_path, prom)
+    assert ok
+    row = next(l for l in text.splitlines()
+               if "profiled top stages" in l)
+    assert "dispatch 42%" in row
+    assert "untagged" not in row

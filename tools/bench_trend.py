@@ -32,6 +32,16 @@ predecessor. Higher-is-better is assumed for all gated columns; lower-
 is-better diagnostics (lag, stall) are never gated here — doctor owns
 those ceilings.
 
+**Attribution diff (ISSUE 15).** Artifacts that carry the profiling
+plane's ``attribution`` block (``bench.py --mode obs`` writes it:
+per-stage self-time fractions + recompile counts + dispatch-gap
+percentiles) get one more row on a FAILED transition: the top-3
+per-stage self-time deltas by name — ``dispatch +18.2pp`` — so a
+flagged headline regression names the stage that moved instead of
+reporting a bare ratio. A recompile-count increase between
+like-for-like artifacts is also named (it is the classic silent cause
+of exactly this kind of drop).
+
 Exit codes: 0 = no gated regression (including "nothing comparable"),
 1 = at least one headline column regressed between like hosts,
 2 = unreadable input. Run:
@@ -65,25 +75,31 @@ HEADLINE_SUFFIXES = ("_events_per_sec", "_qps")
 
 
 class Artifact:
-    __slots__ = ("path", "series", "round", "metric", "host", "columns")
+    __slots__ = ("path", "series", "round", "metric", "host",
+                 "columns", "attribution")
 
     def __init__(self, path: Path, series: str, rnd: int, metric: str,
-                 host: Optional[dict], columns: Dict[str, float]):
+                 host: Optional[dict], columns: Dict[str, float],
+                 attribution: Optional[dict] = None):
         self.path = path
         self.series = series
         self.round = rnd
         self.metric = metric
         self.host = host
         self.columns = columns
+        self.attribution = attribution
 
 
 def _headline_columns(doc: dict) -> Dict[str, float]:
     """``value`` + every top-level scalar rate column. Nested dicts
     (per-round sections, link-bytes maps) are diagnostics, not
-    headlines."""
+    headlines. A fraction-valued ``value`` (the obs artifact's
+    overhead fraction) is LOWER-is-better and must not gate as a rate
+    — its run's ``*_events_per_sec`` columns still do."""
     cols: Dict[str, float] = {}
     v = doc.get("value")
-    if isinstance(v, (int, float)) and math.isfinite(v):
+    if (isinstance(v, (int, float)) and math.isfinite(v)
+            and doc.get("unit") != "fraction"):
         cols["value"] = float(v)
     for key, val in doc.items():
         if (isinstance(val, (int, float)) and not isinstance(val, bool)
@@ -108,16 +124,51 @@ def load_artifact(path: Path) -> Optional[Artifact]:
         print(f"[trend] {path.name}: no 'metric' key — skipped")
         return None
     host = doc.get("host")
+    attribution = doc.get("attribution")
     return Artifact(path, m.group("series") or "E2E",
                     int(m.group("round")), str(doc["metric"]),
                     host if isinstance(host, dict) else None,
-                    _headline_columns(doc))
+                    _headline_columns(doc),
+                    attribution if isinstance(attribution, dict)
+                    else None)
 
 
 def host_key(host: Optional[dict]) -> Optional[Tuple]:
     if not host:
         return None
     return tuple(host.get(k) for k in HOST_KEYS)
+
+
+def attribution_deltas(prev: Optional[dict], cur: Optional[dict],
+                       top: int = 3) -> List[str]:
+    """Human-readable per-stage self-time deltas (percentage points)
+    between two artifacts' attribution blocks, largest first, plus a
+    recompile-count delta when it grew — the "name the stage" half of
+    a flagged regression. Empty when either side lacks the block."""
+    if not prev or not cur:
+        return []
+    old = prev.get("stages") or {}
+    new = cur.get("stages") or {}
+    if not isinstance(old, dict) or not isinstance(new, dict):
+        return []
+    deltas = []
+    for stage in sorted(set(old) | set(new)):
+        try:
+            d = float(new.get(stage, 0.0)) - float(old.get(stage, 0.0))
+        except (TypeError, ValueError):
+            continue
+        deltas.append((abs(d), stage, d))
+    deltas.sort(reverse=True)
+    out = [f"{stage} {d * 100:+.1f}pp" for _, stage, d in deltas[:top]
+           if abs(d) >= 0.001]
+    try:
+        r_old = int((prev.get("recompiles") or {}).get("total", 0))
+        r_new = int((cur.get("recompiles") or {}).get("total", 0))
+        if r_new > r_old:
+            out.append(f"recompiles {r_old}->{r_new}")
+    except (TypeError, ValueError):
+        pass
+    return out
 
 
 def compare(prev: Artifact, cur: Artifact, max_regression: float
@@ -150,6 +201,18 @@ def compare(prev: Artifact, cur: Artifact, max_regression: float
                      f"{old:,.1f} -> {new:,.1f}",
                      f"{-drop:+.1%}",
                      f"> -{max_regression:.0%}", verdict])
+    if any(r[4] == "FAIL" for r in rows):
+        # Name the stage, not just the ratio: one attribution row per
+        # FAILED transition, from the profiling plane's per-stage
+        # self-time fractions (when both artifacts carry the block).
+        named = attribution_deltas(prev.attribution, cur.attribution)
+        if named:
+            rows.append([f"{base} top stage deltas",
+                         "; ".join(named), "-", "-", "info"])
+        elif prev.attribution is None or cur.attribution is None:
+            rows.append([f"{base} top stage deltas",
+                         "(no attribution block — rerun bench.py "
+                         "--mode obs to profile)", "-", "-", "info"])
     return rows
 
 
