@@ -422,6 +422,11 @@ def cmd_federate(args) -> None:
         collector.bind_obs(telemetry)
         if telemetry._server is not None:
             collector.attach(telemetry._server)
+        if getattr(telemetry, "incidents", None) is not None:
+            # Aggregator-side incidents capture fleet-wide status in
+            # their evidence bundles (dead-worker diagnosis needs the
+            # per-peer rows, not just this process's own registry).
+            telemetry.incidents.bind_collector(collector)
     agg = Aggregator(config, obs=telemetry).start()
     engine = QueryEngine(
         agg.mirror, obs=telemetry, batch_max=config.query_batch_max,
@@ -614,10 +619,11 @@ def _fleet_table(doc: dict) -> str:
             str(inst.get("merge_lag_p99_s", "-")),
             str(inst.get("read_staleness_s", "-")),
             str(inst.get("slo_firing", 0)),
+            str(inst.get("incidents", "-")),
         ])
     return _table(rows, ["role@instance", "age", "pushes", "spans",
                          "events", "series", "top_stage", "lag_p99",
-                         "staleness", "firing"])
+                         "staleness", "firing", "incidents"])
 
 
 def cmd_fleet(args) -> None:
@@ -684,7 +690,10 @@ def cmd_doctor(args) -> None:
     verification) into the verdict. ``--quarantine DIR``
     lists the on-disk dead-letter quarantine in the verdict;
     ``--replay-quarantine`` republishes its frames through the
-    configured transport (the recovery half of the DLQ). Exit codes:
+    configured transport (the recovery half of the DLQ).
+    ``--incident DIR`` replays an incident evidence bundle offline:
+    every evidence part is digest-verified against incident.json and
+    an undiagnosed open incident is a breach. Exit codes:
     0 = all checks pass, 1 = at least one breach, 2 = unreadable
     artifacts."""
     import sys
@@ -738,7 +747,7 @@ def cmd_doctor(args) -> None:
             sys.exit(2)
         print(text)
         if not args.artifacts and not args.quarantine \
-                and not args.scrub:
+                and not args.scrub and not args.incident:
             sys.exit(0 if ok else 1)
         elif not ok:
             # Fall through to the remaining reports, but remember the
@@ -755,11 +764,33 @@ def cmd_doctor(args) -> None:
             logger.error("no such scrub target: %s", e)
             sys.exit(2)
         print(text)
-        if not args.artifacts and not args.quarantine:
+        if not args.artifacts and not args.quarantine \
+                and not args.incident:
             sys.exit(0 if ok and not getattr(args, "_fleet_failed",
                                              False) else 1)
         elif not ok:
             args._scrub_failed = True
+    if args.incident:
+        # Incident replay rides the verdict: the bundle must be
+        # complete, digest-verified, and diagnosed.
+        from attendance_tpu.obs.incident import incident_report
+
+        try:
+            text, ok = incident_report(args.incident)
+        except FileNotFoundError as e:
+            logger.error("no such incident bundle: %s", e)
+            sys.exit(2)
+        except Exception as e:
+            logger.error("unreadable incident bundle: %s", e)
+            sys.exit(2)
+        print(text)
+        if not args.artifacts and not args.quarantine:
+            sys.exit(0 if ok
+                     and not getattr(args, "_fleet_failed", False)
+                     and not getattr(args, "_scrub_failed", False)
+                     else 1)
+        elif not ok:
+            args._incident_failed = True
     if not args.artifacts and not args.quarantine:
         logger.error("doctor needs artifacts and/or --quarantine DIR")
         sys.exit(2)
@@ -784,7 +815,8 @@ def cmd_doctor(args) -> None:
         sys.exit(2)
     print(text)
     if not ok or getattr(args, "_fleet_failed", False) \
-            or getattr(args, "_scrub_failed", False):
+            or getattr(args, "_scrub_failed", False) \
+            or getattr(args, "_incident_failed", False):
         sys.exit(1)
 
 
@@ -1046,6 +1078,13 @@ def main(argv=None) -> None:
                        "(--fleet-dir): every <role>@<instance>.prom "
                        "gets per-role rows, plus fleet-wide merge-lag"
                        "/staleness gates over the merged data")
+    p_doc.add_argument("--incident", default="", metavar="DIR",
+                       help="replay an incident evidence bundle (or a "
+                       "--incident-dir root of bundles) offline: "
+                       "verify every evidence part against the "
+                       "digests in incident.json and judge the "
+                       "diagnosis — exits 1 on an undiagnosed open "
+                       "incident or a corrupt/incomplete bundle")
     p_doc.add_argument("--scrub", action="append", default=None,
                        metavar="DIR",
                        help="also run the offline integrity scrub "

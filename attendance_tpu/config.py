@@ -239,6 +239,15 @@ class Config:
     # single-window spikes (SRE multi-window multi-burn-rate).
     slo_fast_s: float = 60.0
     slo_slow_s: float = 300.0
+    # Incident plane ("" = disabled): correlate live breach conditions
+    # (SLO firings, circuit opens, spill growth, steady recompiles,
+    # merge-lag/staleness/watermark breaches, dead peers, lane stalls,
+    # integrity rejects) into incident records and write a checksummed
+    # evidence bundle per incident under this directory — obs/incident.py.
+    incident_dir: str = ""
+    # Hysteresis: consecutive clean evaluation ticks before an open
+    # incident clears (rides the SLO engine's own firing hysteresis).
+    incident_clear_ticks: int = 3
     # Wire format for the fused pipeline's host->device transfer.
     # Either the link or the host-side pack is the e2e bottleneck,
     # depending on the moment's link rate vs host load; "auto" starts
@@ -512,6 +521,9 @@ class Config:
             raise ValueError(
                 f"fleet_port out of range: {self.fleet_port} "
                 "(0 = off, -1 = ephemeral)")
+        if self.incident_clear_ticks <= 0:
+            raise ValueError("incident_clear_ticks must be positive "
+                             "(clear hysteresis)")
         if self.persist_breaker_failures <= 0:
             raise ValueError("persist_breaker_failures must be positive")
         if self.persist_breaker_cooldown_s <= 0:
@@ -824,6 +836,14 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    help="fast burn-rate window (seconds)")
     p.add_argument("--slo-slow-s", type=float, default=d.slo_slow_s,
                    help="slow burn-rate window (seconds)")
+    p.add_argument("--incident-dir", default=d.incident_dir,
+                   help="enable the incident engine and write one "
+                   "checksummed evidence bundle per correlated breach "
+                   "under this directory (empty = off)")
+    p.add_argument("--incident-clear-ticks", type=int,
+                   default=d.incident_clear_ticks,
+                   help="consecutive clean ticks before an open "
+                   "incident clears (hysteresis)")
     return p
 
 
@@ -912,4 +932,6 @@ def config_from_args(args: argparse.Namespace) -> Config:
         slo=list(args.slo or []),
         slo_fast_s=args.slo_fast_s,
         slo_slow_s=args.slo_slow_s,
+        incident_dir=args.incident_dir,
+        incident_clear_ticks=args.incident_clear_ticks,
     ).validate()

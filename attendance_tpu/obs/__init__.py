@@ -94,6 +94,11 @@ def _atexit_flush() -> None:
     # land in the alert log AND in the gauges), then write the final
     # exposition block carrying those gauges, then the trace.
     t.finalize_slo("atexit")
+    if t.incidents is not None:
+        try:
+            t.incidents.finalize("atexit")  # persist a still-open record
+        except Exception:
+            logger.exception("atexit incident finalize failed")
     if t._reporter is not None:
         try:
             t._reporter._write_block()
@@ -135,7 +140,8 @@ def enabled_in(config) -> bool:
                 or getattr(config, "alert_log", "")
                 or getattr(config, "slo", None)
                 or getattr(config, "fleet_push", "")
-                or getattr(config, "profile_hz", 0.0))
+                or getattr(config, "profile_hz", 0.0)
+                or getattr(config, "incident_dir", ""))
 
 
 class Telemetry:
@@ -152,7 +158,9 @@ class Telemetry:
                  fleet_instance: str = "",
                  fleet_push_interval_s: float = 2.0,
                  metric_series_max: int = 1024,
-                 profile_hz: float = 0.0, profile_out: str = ""):
+                 profile_hz: float = 0.0, profile_out: str = "",
+                 incident_dir: str = "",
+                 incident_clear_ticks: int = 3):
         self.registry = Registry(max_series=metric_series_max)
         self.flight: Optional[FlightRecorder] = (
             FlightRecorder(flight_recorder) if flight_recorder > 0
@@ -204,6 +212,20 @@ class Telemetry:
             self.profiler = SamplingProfiler(
                 profile_hz, registry=self.registry,
                 out_dir=profile_out)
+        # Incident plane (obs/incident.py): correlates live breach
+        # conditions (SLO firings, circuit opens, spill growth, steady
+        # recompiles, lag/staleness breaches, dead peers, lane stalls)
+        # into incident records with checksummed evidence bundles under
+        # --incident-dir. Created after the sources it subscribes to.
+        self.incidents = None
+        if incident_dir:
+            from attendance_tpu.obs.incident import IncidentEngine
+            self.incidents = IncidentEngine(
+                self, incident_dir,
+                role=self._fleet_role,
+                instance=fleet_instance,
+                clear_ticks=incident_clear_ticks,
+                interval_s=min(metrics_interval_s, 1.0))
         self._reporter = None
         self._server = None
         self._prev_sigusr1 = _NOT_INSTALLED
@@ -235,6 +257,10 @@ class Telemetry:
                                                  self.flight_path)
         if self.slo is not None:
             self.slo.start()
+        if self.incidents is not None:
+            # After the SLO engine: the first incident tick must see
+            # engine state, not a half-constructed firing map.
+            self.incidents.start()
         if self.profiler is not None:
             self.profiler.start()
         if self._fleet_push:
@@ -247,7 +273,8 @@ class Telemetry:
                           or default_instance()),
                 interval_s=self._fleet_interval).start()
         if (self.tracer is not None or self._reporter is not None
-                or self.slo is not None or self.profiler is not None):
+                or self.slo is not None or self.profiler is not None
+                or self.incidents is not None):
             # Backstop for CLI runs that never reach a run-loop flush
             # (KeyboardInterrupt, runs shorter than the reporter
             # interval); every flush is idempotent. ONE module-level
@@ -262,6 +289,11 @@ class Telemetry:
 
     def stop(self) -> None:
         self.flush_trace("telemetry-stop")
+        if self.incidents is not None:
+            # Persist a still-open incident record while every evidence
+            # source below is alive, then stop the tick thread.
+            self.incidents.finalize("telemetry-stop")
+            self.incidents.stop()
         if self.profiler is not None:
             # Sampler thread joined BEFORE the fleet drain below: the
             # final push carries the profiler's last stage fractions,
@@ -420,7 +452,10 @@ def enable(config) -> Telemetry:
             metric_series_max=getattr(config, "metric_series_max",
                                       1024),
             profile_hz=getattr(config, "profile_hz", 0.0),
-            profile_out=getattr(config, "profile_out", ""))
+            profile_out=getattr(config, "profile_out", ""),
+            incident_dir=getattr(config, "incident_dir", ""),
+            incident_clear_ticks=getattr(config, "incident_clear_ticks",
+                                         3))
         t.start()
         TELEMETRY = t
         return t

@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import logging
 import math
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -92,15 +93,32 @@ def render(registry: Registry) -> str:
                 lines.append(f"{name}{_labels(m.labels)} {_fmt(v)}")
             elif isinstance(m, Histogram):
                 buckets, total, count = m.snapshot()
+                # OpenMetrics-style exemplar on the landing bucket: the
+                # worst traced observation of this scrape window, so a
+                # p99 breach links straight to its span tree. Reading
+                # it resets the window (best-effort sample semantics).
+                ex = m.exemplar()
+                ex_idx = -1
+                if ex is not None:
+                    u = int(ex[0] * m.scale)
+                    ex_idx = min(u.bit_length() if u > 0 else 0,
+                                 NUM_BUCKETS)
+                ex_suffix = ("" if ex is None else
+                             f' # {{trace_id="{_escape(ex[1])}"}}'
+                             f" {_fmt(ex[0])}")
                 cum = 0
                 for i in range(NUM_BUCKETS):
                     cum += buckets[i]
                     le = 'le="%s"' % _fmt(m.bucket_bound(i))
-                    lines.append(
-                        f"{name}_bucket{_labels(m.labels, le)} {cum}")
+                    line = f"{name}_bucket{_labels(m.labels, le)} {cum}"
+                    if i == ex_idx:
+                        line += ex_suffix
+                    lines.append(line)
                 inf = 'le="+Inf"'
-                lines.append(
-                    f"{name}_bucket{_labels(m.labels, inf)} {count}")
+                line = f"{name}_bucket{_labels(m.labels, inf)} {count}"
+                if ex_idx == NUM_BUCKETS:
+                    line += ex_suffix
+                lines.append(line)
                 lines.append(f"{name}_sum{_labels(m.labels)} {_fmt(total)}")
                 lines.append(f"{name}_count{_labels(m.labels)} {count}")
     return "\n".join(lines) + "\n"
@@ -245,6 +263,11 @@ def parse_prom(text: str):
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        if " # " in line:
+            # Drop an OpenMetrics exemplar suffix (` # {trace_id=...}
+            # <value>`) so pre-exemplar offline consumers keep parsing
+            # the sample itself; parse_exemplars reads the suffix.
+            line = line.split(" # ", 1)[0].rstrip()
         try:
             metric, value = line.rsplit(" ", 1)
         except ValueError:
@@ -256,6 +279,44 @@ def parse_prom(text: str):
             name, labels = metric, ""
         samples.append((name, labels, value))
     return samples
+
+
+_EXEMPLAR_RE = re.compile(r'\{trace_id="([^"]*)"\}\s+(\S+)')
+
+
+def parse_exemplars(text: str):
+    """OpenMetrics exemplars of the LAST scrape block:
+    {(base_name, labels_without_le): (value, trace_id)} — the worst
+    traced observation per histogram series, the jump from a latency
+    breach into the trace slice."""
+    blocks = text.split("# scrape ")
+    last = blocks[-1]
+    if len(blocks) > 1:
+        last = last.split("\n", 1)[1] if "\n" in last else ""
+    out = {}
+    for line in last.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or " # " not in line:
+            continue
+        metric_part, ex_part = line.split(" # ", 1)
+        m = _EXEMPLAR_RE.match(ex_part.strip())
+        if m is None:
+            continue
+        metric = metric_part.rsplit(" ", 1)[0]
+        if "{" in metric:
+            name, rest = metric.split("{", 1)
+            labels = rest.rstrip("}")
+        else:
+            name, labels = metric, ""
+        if name.endswith("_bucket"):
+            name = name[:-len("_bucket")]
+            labels = ",".join(p for p in labels.split(",")
+                              if p and not p.startswith("le="))
+        try:
+            out[(name, labels)] = (float(m.group(2)), m.group(1))
+        except ValueError:
+            continue
+    return out
 
 
 def _table(rows: List[List[str]], headers: List[str]) -> str:
@@ -329,7 +390,7 @@ def fold_headline_samples(samples, acc: Optional[dict] = None) -> dict:
     if acc is None:
         acc = {"events": 0.0, "have_events": False, "firing": 0,
                "staleness": [], "series": None, "lag_by_le": {},
-               "prof_stages": {}}
+               "prof_stages": {}, "incidents": None}
     for name, labels, value in samples:
         try:
             v = float(value)
@@ -346,6 +407,10 @@ def fold_headline_samples(samples, acc: Optional[dict] = None) -> dict:
             acc["staleness"].append(v)
         elif name == "attendance_metric_series_total":
             acc["series"] = int(v)
+        elif name == "attendance_incidents_open":
+            # Summed across folded instances; None stays "metric
+            # absent" (pre-17 exposition) vs 0 "engine on, no incident".
+            acc["incidents"] = int(v) + (acc["incidents"] or 0)
         elif name == "attendance_profile_stage_fraction":
             # Sampling-profiler self-time per stage (ISSUE 15) — the
             # fleet surfaces render each role's top stage from it.
@@ -379,6 +444,7 @@ def format_prom_table(text: str) -> str:
     from the cumulative buckets (registry.Histogram.quantile's offline
     twin) — the raw buckets stay in the file for machine consumers."""
     samples = parse_prom(text)
+    exemplars = parse_exemplars(text)
     hist: dict = {}
     rows = []
     for name, labels, value in samples:
@@ -408,6 +474,9 @@ def format_prom_table(text: str) -> str:
             p50, p95, p99 = quantiles_from_cumulative(
                 h["_buckets"], (0.50, 0.95, 0.99))
             cell += (f" p50={p50:.6g} p95={p95:.6g} p99={p99:.6g}")
+        ex = exemplars.get((base, labels))
+        if ex is not None:
+            cell += f" exemplar={ex[1]}@{ex[0]:.6g}"
         rows.append([base, labels, cell])
     rows.sort()
     return _table(rows, ["metric", "labels", "value"])

@@ -666,6 +666,8 @@ def _headline(prom_text: str) -> dict:
         out["read_staleness_s"] = round(max(acc["staleness"]), 3)
     if acc["series"] is not None:
         out["series"] = acc["series"]
+    if acc.get("incidents") is not None:
+        out["incidents"] = acc["incidents"]
     if acc["prof_stages"]:
         # The role's busiest profiled stage (sampling profiler on) —
         # the dashboard's per-role "where does the time go" cell,

@@ -159,7 +159,7 @@ class Histogram:
     """
 
     __slots__ = ("name", "labels", "help", "scale", "_lock", "_buckets",
-                 "_overflow", "_sum", "_count")
+                 "_overflow", "_sum", "_count", "_exemplar")
 
     def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...],
                  help: str = "", scale: float = 1e6):
@@ -176,8 +176,12 @@ class Histogram:
         self._overflow = 0
         self._sum = 0.0
         self._count = 0
+        # Worst observation carrying a trace id since the last scrape:
+        # the OpenMetrics exemplar that links a p99 breach straight to
+        # the span tree of the batch that caused it.
+        self._exemplar: Optional[Tuple[float, str]] = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: str = "") -> None:
         u = int(value * self.scale)
         idx = u.bit_length() if u > 0 else 0
         with self._lock:
@@ -187,6 +191,21 @@ class Histogram:
                 self._buckets[idx] += 1
             self._sum += value
             self._count += 1
+            if trace_id and (self._exemplar is None
+                             or value >= self._exemplar[0]):
+                self._exemplar = (value, trace_id)
+
+    def exemplar(self, reset: bool = True) -> Optional[Tuple[float, str]]:
+        """(value, trace_id) of the worst traced observation in the
+        current window, or None. ``reset`` starts a new window (the
+        exposition layer resets per scrape, so each block carries that
+        interval's worst batch — exemplars are best-effort samples,
+        not cumulative state)."""
+        with self._lock:
+            ex = self._exemplar
+            if reset:
+                self._exemplar = None
+            return ex
 
     def bucket_bound(self, idx: int) -> float:
         """Upper bound (observed units) of bucket ``idx``."""

@@ -48,6 +48,11 @@ DEFAULT_BUDGET = 0.01
 DEFAULT_FIRE_BURN = 14.4
 CLEAR_RATIO = 0.5  # hysteresis: clear only below half the fire burn
 
+# Version stamped on every alert-log JSONL event and incident record so
+# offline consumers can evolve; pre-17 logs have no field and readers
+# tolerate that with a single warning.
+ALERT_SCHEMA = 1
+
 SLO_HELP = {
     "attendance_slo_burn_rate":
         "SLO burn rate (breaching fraction / error budget) per window",
@@ -392,6 +397,7 @@ class SloEngine:
         trace = self._last_trace()
         value = st.last_value
         event = {
+            "schema": ALERT_SCHEMA,
             "ts": round(time.time(), 3),
             "slo": slo.name,
             "state": state,
@@ -883,7 +889,10 @@ def _alert_checks(events: List[dict]) -> Tuple[List[List[str]],
     last_state: Dict[str, str] = {}
     fired: Dict[str, int] = {}
     traces: List[str] = []
+    versionless = 0
     for e in events:
+        if e.get("schema") is None:
+            versionless += 1
         last_state[e["slo"]] = e.get("state", "")
         if e.get("state") == "firing":
             fired[e["slo"]] = fired.get(e["slo"], 0) + 1
@@ -892,6 +901,12 @@ def _alert_checks(events: List[dict]) -> Tuple[List[List[str]],
     rows: List[List[str]] = []
     if not events:
         rows.append(["alert log", "0 transitions", "-", "PASS"])
+    if versionless:
+        # Pre-17 alert logs predate the schema field: readable, but flag
+        # once so operators know which vintage they are replaying.
+        rows.append(["alert log schema",
+                     f"{versionless} versionless event(s) (pre-17 log)",
+                     f"schema={ALERT_SCHEMA}", "warn"])
     for slo in sorted(last_state):
         unresolved = last_state[slo] == "firing"
         rows.append([f"alert {slo}",

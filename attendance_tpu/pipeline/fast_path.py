@@ -1158,8 +1158,6 @@ class FusedPipeline:
         t_end = time.perf_counter()
         self.metrics.device_seconds += t_end - t0
         if obs_t is not None:
-            self._h_decode.observe(t_dec - t0)
-            self._h_dispatch.observe(t_end - t_dec)
             self._last_dispatch_t = t_end
             # Occupancy split feeding the busy-fraction gauges: the
             # temporal host passes are carved OUT of the dispatch
@@ -1184,6 +1182,12 @@ class FusedPipeline:
                             parent_id=parent, role=self._TRACE_ROLE,
                             args={"wire": self._last_wire})
                 trace_hex = f"{tid:016x}"
+            # Stage observations carry the trace id as an OpenMetrics
+            # exemplar candidate: the exposition emits the window's
+            # worst batch on its landing bucket, so a p99 breach links
+            # straight into the span tree (empty id = no exemplar).
+            self._h_decode.observe(t_dec - t0, trace_hex)
+            self._h_dispatch.observe(t_end - t_dec, trace_hex)
             rec = dict(
                 ts=round(time.time(), 6), events=n,
                 wire=self._last_wire,

@@ -338,6 +338,14 @@ class IngressLane:
             self.metrics_blocks += 1
             if self._c_events is not None:
                 self._c_events.inc(block.n)
+            if self._obs is not None:
+                # Per-lane flight record: a SIGUSR1 ring dump of a
+                # striped run must show WHICH lane each block came
+                # through, not just the dispatcher's merged stream
+                # (no-op without --flight-recorder).
+                self._obs.record_batch(
+                    ts=round(time.time(), 6), lane=self.index,
+                    events=block.n, queued=len(self.queue))
             if not self.queue.put(block, stop=self._stop):
                 return
 

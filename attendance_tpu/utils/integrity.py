@@ -422,6 +422,32 @@ def _scrub_events(d: Path, rows: List[ScrubRow]) -> None:
                                  "structural (zip CRC) check"))
 
 
+def _scrub_incident(d: Path, rows: List[ScrubRow]) -> None:
+    """Incident evidence bundle (obs/incident.py): ``incident.json``
+    doubles as the bundle manifest — it records a sha256 per evidence
+    part (it is deliberately NOT named MANIFEST.json, which would
+    collide with the store-chain family above). A torn record takes
+    its digests with it; the parts then get an existence-only sweep so
+    rot is still reported."""
+    record_path = d / "incident.json"
+    try:
+        record = json.loads(record_path.read_text())
+    except (ValueError, OSError) as exc:
+        rows.append(ScrubRow(record_path, "incident-record", "CORRUPT",
+                             "torn_manifest", str(exc)))
+        for p in sorted(d.iterdir()):
+            if p.is_file() and p.name != "incident.json":
+                rows.append(ScrubRow(p, "incident-evidence", "legacy",
+                                     "", "record torn: existence "
+                                     "check only"))
+        return
+    rows.append(ScrubRow(record_path, "incident-record", "ok", "",
+                         f"incident {record.get('id', '?')}"))
+    for name, expected in sorted(
+            (record.get("evidence") or {}).items()):
+        _scrub_file(rows, d / name, "incident-evidence", expected)
+
+
 def _scrub_quarantine(d: Path, rows: List[ScrubRow]) -> None:
     for meta_path in sorted(d.glob("q-*.json")):
         frame = meta_path.with_suffix(".frame")
@@ -473,6 +499,8 @@ def scrub_dir(directory) -> List[ScrubRow]:
         _scrub_events(d, rows)
     if any(d.glob("q-*.json")) or any(d.glob("q-*.frame")):
         _scrub_quarantine(d, rows)
+    if (d / "incident.json").exists():
+        _scrub_incident(d, rows)
     for sub in sorted(p for p in d.iterdir() if p.is_dir()
                       and p.name != QUARANTINE_SUBDIR):
         try:

@@ -488,7 +488,7 @@ def _doctor_artifacts(tmp_path, breached: bool):
     alerts = tmp_path / "alerts.jsonl"
     if breached:
         alerts.write_text(json.dumps(
-            {"ts": 1.0, "slo": "bloom_measured_fpr",
+            {"schema": 1, "ts": 1.0, "slo": "bloom_measured_fpr",
              "state": "firing", "threshold": 0.01, "value": 0.02,
              "burn_fast": 75.0, "burn_slow": 20.0,
              "trace": "00000000deadbeef"}) + "\n")

@@ -1,0 +1,594 @@
+"""Incident plane tests (ISSUE 17): correlated breach detection,
+evidence bundles, the rule-driven diagnosis table, ``doctor
+--incident`` replay, the alert-log schema field, exemplars, and the
+per-lane flight-recorder routing fix."""
+
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from attendance_tpu import chaos, obs
+from attendance_tpu.config import Config
+from attendance_tpu.obs.incident import (
+    EVIDENCE_PARTS,
+    IncidentEngine,
+    diagnose,
+    find_bundles,
+    incident_report,
+)
+from attendance_tpu.obs.slo import ALERT_SCHEMA
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    chaos.disable()
+    obs.disable()
+    yield
+    chaos.disable()
+    obs.disable()
+
+
+def _engine(tmp_path, **cfg_kw):
+    """Telemetry + a stopped incident engine driven by manual ticks."""
+    cfg_kw.setdefault("incident_dir", str(tmp_path / "incidents"))
+    t = obs.enable(Config(**cfg_kw))
+    eng = t.incidents
+    assert isinstance(eng, IncidentEngine)
+    eng.stop()  # tests drive tick() directly, like the SLO suite
+    eng.dir.mkdir(parents=True, exist_ok=True)
+    return t, eng
+
+
+def _bundle_dirs(eng):
+    return find_bundles(eng.dir)
+
+
+# -- diagnosis signature table ----------------------------------------------
+
+def test_diagnose_golden_table():
+    """The spec's four composite signatures rank their named cause
+    first, and every single condition maps to some rule (no
+    undiagnosable lone signal)."""
+    golden = [
+        ({"circuit_open", "spill_growth", "slo_burn"}, "persist_sink_down"),
+        ({"circuit_open", "spill_growth"}, "persist_sink_down"),
+        ({"steady_recompiles"}, "shape_churn"),
+        ({"steady_recompiles", "throughput_drop", "dispatch_gap"},
+         "shape_churn"),
+        ({"peer_down", "merge_lag"}, "dead_worker"),
+        ({"peer_down"}, "dead_worker"),
+        ({"throughput_drop", "stage_shift"}, "temporal_dispatch_pass"),
+        ({"merge_lag"}, "fed_merge_backlog"),
+        ({"read_staleness"}, "stale_reads"),
+        ({"watermark_lag"}, "watermark_stall"),
+        ({"lane_stall"}, "lane_stall"),
+        ({"circuit_open"}, "sink_circuit_open"),
+        ({"integrity_rejects"}, "wire_rot"),
+        ({"slo_burn"}, "slo_burn"),
+        ({"dispatch_gap"}, "dispatch_gap"),
+    ]
+    for conds, expected in golden:
+        ranked = diagnose(conds)
+        assert ranked, f"no diagnosis for {conds}"
+        assert ranked[0]["rule"] == expected, (conds, ranked[0])
+        # Scores are monotone non-increasing and every match lists
+        # only conditions actually present.
+        scores = [r["score"] for r in ranked]
+        assert scores == sorted(scores, reverse=True)
+        for r in ranked:
+            assert set(r["matched"]) <= conds
+
+
+def test_diagnose_specificity_beats_breadth():
+    """persist_sink_down (two required conditions) outranks the broad
+    sink_circuit_open rule when spill is actually growing."""
+    ranked = diagnose({"circuit_open", "spill_growth"})
+    names = [r["rule"] for r in ranked]
+    assert names.index("persist_sink_down") < names.index("sink_circuit_open")
+
+
+# -- open / clear hysteresis -------------------------------------------------
+
+def test_incident_open_clear_hysteresis(tmp_path):
+    t, eng = _engine(tmp_path, incident_clear_ticks=3)
+    circuit = t.registry.gauge("attendance_circuit_state", sink="disk")
+
+    assert eng.tick() is None  # warm-up, no conditions
+    circuit.set(1.0)
+    iid = eng.tick()  # breach visible -> opens within ONE tick
+    assert iid is not None and iid.startswith("inc-")
+    assert t.registry.gauge("attendance_incidents_open").read() == 1.0
+    assert t.registry.counter("attendance_incidents_total",
+                              rule="sink_circuit_open").value == 1
+
+    circuit.set(0.0)
+    assert eng.tick() == iid  # 1 clean tick: still open (hysteresis)
+    assert eng.tick() == iid  # 2 clean ticks: still open
+    assert eng.tick() is None  # 3rd clean tick: cleared
+    assert t.registry.gauge("attendance_incidents_open").read() == 0.0
+
+    [bundle] = _bundle_dirs(eng)
+    rec = json.loads((bundle / "incident.json").read_text())
+    assert rec["schema"] == ALERT_SCHEMA
+    assert rec["kind"] == "incident"
+    assert rec["id"] == iid
+    assert rec["cleared_unix"] is not None
+    assert rec["cleared_unix"] >= rec["opened_unix"]
+    assert rec["conditions"] == ["circuit_open"]
+    assert rec["diagnosis_top"] == "sink_circuit_open"
+
+
+def test_secondary_conditions_never_open_alone(tmp_path):
+    """throughput_drop / stage_shift corroborate but never page alone:
+    a benign idle tail (rate collapses to zero after sustained load)
+    must not open an undiagnosed incident."""
+    t, eng = _engine(tmp_path)
+    events = t.registry.counter("attendance_events_total")
+    frac = t.registry.gauge("attendance_profile_stage_fraction",
+                            stage="dispatch")
+    frac.set(0.10)
+    eng.tick()  # warm
+    for _ in range(4):  # sustained load builds the rate EMA
+        events.inc(10_000)
+        assert eng.tick() is None
+    frac.set(0.80)  # stage shift far past the 20pp ceiling
+    for _ in range(4):  # idle tail: rate 0 trips the drop detector
+        assert eng.tick() is None
+    assert eng.total_opened == 0
+
+    # ...but the same signals DO corroborate an open incident: they
+    # merge in and raise persist_sink_down via its optional set.
+    t.registry.gauge("attendance_circuit_state", sink="disk").set(1.0)
+    iid = eng.tick()
+    assert iid is not None
+    assert "circuit_open" in eng._open.conditions
+
+
+def test_flap_does_not_churn_bundles(tmp_path):
+    """A flapping signal keeps ONE incident open instead of opening a
+    new bundle per oscillation."""
+    t, eng = _engine(tmp_path)
+    circuit = t.registry.gauge("attendance_circuit_state", sink="disk")
+    eng.tick()
+    for i in range(8):
+        circuit.set(1.0 if i % 2 == 0 else 0.0)
+        eng.tick()
+    assert eng.total_opened == 1
+    assert len(_bundle_dirs(eng)) == 1
+
+
+# -- evidence bundle ---------------------------------------------------------
+
+def test_bundle_completeness_and_checksums(tmp_path):
+    t, eng = _engine(tmp_path, flight_recorder=16,
+                     trace_out=str(tmp_path / "trace.json"))
+    t.record_batch(ts=1.0, batch=1, events=32)
+    t.registry.gauge("attendance_circuit_state", sink="disk").set(1.0)
+    eng.tick()
+    iid = eng.tick()
+    assert iid is not None
+
+    [bundle] = _bundle_dirs(eng)
+    manifest = json.loads((bundle / "incident.json").read_text())["evidence"]
+    for name in EVIDENCE_PARTS + ("diagnosis.json",):
+        part = bundle / name
+        assert part.is_file(), f"missing evidence part {name}"
+        digest = hashlib.sha256(part.read_bytes()).hexdigest()
+        assert manifest[name] == digest, f"manifest mismatch for {name}"
+
+    flight = json.loads((bundle / "flight.json").read_text())
+    assert flight["collected"] is True
+    assert any(r.get("batch") == 1 for r in flight["records"])
+    trace = json.loads((bundle / "trace_slice.json").read_text())
+    assert trace["collected"] is True
+    attribution = json.loads((bundle / "attribution.json").read_text())
+    assert "collected" in attribution
+    fleet = json.loads((bundle / "fleet_status.json").read_text())
+    assert "instances" in fleet
+    assert "attendance_incidents_open 1" in \
+        (bundle / "metrics.prom").read_text()
+
+    text, ok = incident_report(eng.dir)
+    assert ok, text
+    assert "sha256 ok" in text and "PASS" in text
+
+    # Corrupt one part: the offline replay must fail the bundle.
+    (bundle / "attribution.json").write_text("{}")
+    text, ok = incident_report(eng.dir)
+    assert not ok
+    assert "digest mismatch" in text
+
+
+def test_absent_subsystems_yield_stubs_not_holes(tmp_path):
+    """Without flight ring / tracer / collector the bundle still has
+    all five parts, each an explicit collected=false stub."""
+    t, eng = _engine(tmp_path)
+    t.registry.gauge("attendance_read_staleness_seconds").set(60.0)
+    eng.tick()
+    assert eng.tick() is not None
+    [bundle] = _bundle_dirs(eng)
+    for name in EVIDENCE_PARTS:
+        assert (bundle / name).is_file()
+    assert json.loads((bundle / "flight.json").read_text())["collected"] \
+        is False
+    assert json.loads(
+        (bundle / "fleet_status.json").read_text())["collected"] is False
+    _, ok = incident_report(bundle)
+    assert ok
+
+
+def test_merge_rediagnoses_on_new_conditions(tmp_path):
+    """New conditions arriving while open merge into the SAME incident
+    and re-rank the diagnosis (circuit alone -> + spill growth)."""
+    t, eng = _engine(tmp_path)
+    spilled = t.registry.counter("attendance_persist_spilled_batches_total")
+    circuit = t.registry.gauge("attendance_circuit_state", sink="disk")
+    eng.tick()  # warm (spilled counter seen at 0)
+    circuit.set(1.0)
+    iid = eng.tick()
+    assert iid is not None
+    assert eng._open.top_rule == "sink_circuit_open"
+
+    spilled.inc(5)
+    assert eng.tick() == iid  # merged, not a second incident
+    assert eng.total_opened == 1
+    assert eng._open.conditions == {"circuit_open", "spill_growth"}
+    assert eng._open.top_rule == "persist_sink_down"
+    [bundle] = _bundle_dirs(eng)
+    dx = json.loads((bundle / "diagnosis.json").read_text())
+    assert dx["top"] == "persist_sink_down"
+    rec = json.loads((bundle / "incident.json").read_text())
+    assert rec["diagnosis_top"] == "persist_sink_down"
+
+
+# -- the three chaos scenarios (acceptance) ----------------------------------
+
+def test_chaos_persist_sink_failure(tmp_path):
+    """Persist-sink failure: breaker open + spill growth must open
+    within one tick with persist_sink_down ranked first."""
+    t, eng = _engine(tmp_path)
+    spilled = t.registry.counter("attendance_persist_spilled_batches_total")
+    circuit = t.registry.gauge("attendance_circuit_state", sink="disk")
+    eng.tick()  # warm
+
+    circuit.set(1.0)
+    spilled.inc(7)
+    iid = eng.tick()  # <= one evaluation tick after the breach
+    assert iid is not None
+    assert eng._open.conditions >= {"circuit_open", "spill_growth"}
+    assert eng._open.top_rule == "persist_sink_down"
+    [bundle] = _bundle_dirs(eng)
+    for name in EVIDENCE_PARTS:
+        assert (bundle / name).is_file()
+    ranked = json.loads((bundle / "diagnosis.json").read_text())["ranked"]
+    assert ranked[0]["rule"] == "persist_sink_down"
+
+
+def test_chaos_recompile_storm(tmp_path):
+    """Injected recompile storm via shape churn: steady-state
+    fingerprints appearing after warm-up diagnose as shape_churn."""
+    t, eng = _engine(tmp_path)
+    t.recompiles.mark_warm()
+    eng.tick()  # warm (steady counter seen)
+
+    for i in range(4):  # shape churn: new fingerprint per batch
+        t.recompiles.observe("dispatch_frame", (128 + i, 8))
+    iid = eng.tick()
+    assert iid is not None
+    assert "steady_recompiles" in eng._open.conditions
+    assert eng._open.top_rule == "shape_churn"
+    [bundle] = _bundle_dirs(eng)
+    for name in EVIDENCE_PARTS:
+        assert (bundle / name).is_file()
+    ranked = json.loads((bundle / "diagnosis.json").read_text())["ranked"]
+    assert ranked[0]["rule"] == "shape_churn"
+    # The recompile ledger rides in the attribution evidence.
+    attribution = json.loads((bundle / "attribution.json").read_text())
+    assert attribution.get("recompiles", {}).get("steady", 0) >= 4
+
+
+def test_chaos_dead_federation_worker(tmp_path):
+    """SIGKILLed federation worker: peer marked down while merge lag
+    grows diagnoses dead_worker ahead of the broad backlog rule."""
+    t, eng = _engine(tmp_path)
+    peer = t.registry.gauge("attendance_fed_peer_up", peer="room-b")
+    peer.set(1.0)
+    lag = t.registry.histogram("attendance_fed_merge_lag_seconds")
+    lag.observe(0.01)
+    eng.tick()  # warm (histogram snapshot recorded)
+
+    peer.set(0.0)  # worker killed
+    for _ in range(10):
+        lag.observe(30.0)  # merges now lag far over the 5s ceiling
+    iid = eng.tick()
+    assert iid is not None
+    assert eng._open.conditions >= {"peer_down", "merge_lag"}
+    assert eng._open.top_rule == "dead_worker"
+    [bundle] = _bundle_dirs(eng)
+    for name in EVIDENCE_PARTS:
+        assert (bundle / name).is_file()
+    ranked = json.loads((bundle / "diagnosis.json").read_text())["ranked"]
+    names = [r["rule"] for r in ranked]
+    assert names[0] == "dead_worker"
+    assert "fed_merge_backlog" in names  # matched, but outranked
+
+
+# -- doctor --incident replay ------------------------------------------------
+
+def _open_clean_bundle(tmp_path):
+    t, eng = _engine(tmp_path)
+    t.registry.gauge("attendance_circuit_state", sink="disk").set(1.0)
+    eng.tick()
+    assert eng.tick() is not None
+    [bundle] = _bundle_dirs(eng)
+    obs.disable()
+    return bundle
+
+
+def test_doctor_incident_exit_zero_on_clean_bundle(tmp_path):
+    from attendance_tpu.cli import main
+    bundle = _open_clean_bundle(tmp_path)
+    with pytest.raises(SystemExit) as exc:
+        main(["doctor", "--incident", str(bundle.parent)])
+    assert exc.value.code == 0
+
+
+def test_doctor_incident_exit_one_on_undiagnosed_open(tmp_path):
+    from attendance_tpu.cli import main
+    bundle = _open_clean_bundle(tmp_path)
+    rec = json.loads((bundle / "incident.json").read_text())
+    rec["cleared_unix"] = None
+    rec["diagnosis_top"] = ""  # open AND undiagnosed -> operator page
+    (bundle / "incident.json").write_text(json.dumps(rec))
+    with pytest.raises(SystemExit) as exc:
+        main(["doctor", "--incident", str(bundle)])
+    assert exc.value.code == 1
+
+
+def test_doctor_incident_exit_one_on_corrupt_evidence(tmp_path):
+    from attendance_tpu.cli import main
+    bundle = _open_clean_bundle(tmp_path)
+    (bundle / "metrics.prom").write_text("tampered\n")
+    with pytest.raises(SystemExit) as exc:
+        main(["doctor", "--incident", str(bundle)])
+    assert exc.value.code == 1
+
+
+def test_doctor_incident_exit_two_on_missing_dir(tmp_path):
+    from attendance_tpu.cli import main
+    with pytest.raises(SystemExit) as exc:
+        main(["doctor", "--incident", str(tmp_path / "nope")])
+    assert exc.value.code == 2
+
+
+def test_scrubber_recognises_bundle_family(tmp_path):
+    """The rot scrubber verifies bundle parts against the incident
+    manifest instead of flagging them as unknown files."""
+    from attendance_tpu.utils.integrity import scrub_report
+    bundle = _open_clean_bundle(tmp_path)
+    text, ok = scrub_report([str(bundle)])
+    assert ok, text
+    assert "incident-record" in text
+    assert "incident-evidence" in text
+
+
+# -- fleet incidents column --------------------------------------------------
+
+def test_fleet_incidents_column(tmp_path):
+    from attendance_tpu.cli import _fleet_table
+    from attendance_tpu.obs.exposition import (
+        fold_headline_samples, parse_prom)
+    t, eng = _engine(tmp_path)
+    t.registry.gauge("attendance_circuit_state", sink="disk").set(1.0)
+    eng.tick()
+    assert eng.tick() is not None
+
+    acc = fold_headline_samples(parse_prom(t.render()))
+    assert acc["incidents"] == 1
+
+    table = _fleet_table({"instances": {
+        "ingest@1": {"age_s": 1.0, "pushes": 2, "spans": 0,
+                     "incidents": 1},
+        "serve@2": {"age_s": 1.0, "pushes": 2, "spans": 0},
+    }})
+    assert "incidents" in table
+    lines = [l for l in table.splitlines() if "ingest@1" in l]
+    assert lines and lines[0].rstrip().endswith("1")
+    serve = [l for l in table.splitlines() if "serve@2" in l]
+    assert serve and serve[0].rstrip().endswith("-")
+
+
+def test_incident_spans_and_metrics(tmp_path):
+    """Open/clear/diagnosis are first-class spans when tracing is on,
+    and the counter labels the top rule."""
+    t, eng = _engine(tmp_path, trace_out=str(tmp_path / "trace.json"),
+                     incident_clear_ticks=1)
+    circuit = t.registry.gauge("attendance_circuit_state", sink="disk")
+    eng.tick()
+    circuit.set(1.0)
+    assert eng.tick() is not None
+    circuit.set(0.0)
+    assert eng.tick() is None  # clear_ticks=1
+
+    names = [e.get("name") for e in t.tracer.export()["traceEvents"]]
+    assert "incident_open" in names
+    assert "incident_diagnosis" in names
+    assert "incident_clear" in names
+    text = t.render()
+    assert 'attendance_incidents_total{rule="sink_circuit_open"} 1' in text
+
+
+# -- alert-log schema field (satellite 1) ------------------------------------
+
+def test_alert_log_events_carry_schema(tmp_path):
+    from attendance_tpu.obs.slo import SloEngine
+    t = obs.enable(Config(flight_recorder=8))
+    path = tmp_path / "alerts.jsonl"
+    eng = SloEngine(t, (), fast_s=4.0, slow_s=20.0, path=str(path))
+    fpr = t.registry.gauge("attendance_bloom_measured_fpr")
+    fpr.set(0.05)
+    for i in range(25):
+        eng.tick(now=float(i))
+    events = [json.loads(l) for l in path.read_text().splitlines()]
+    assert events
+    assert all(e["schema"] == ALERT_SCHEMA for e in events)
+
+
+def test_doctor_warns_once_on_versionless_alert_log(tmp_path):
+    """Pre-17 alert logs (no schema field) replay fine with exactly
+    one vintage warning row; versioned logs get no warning."""
+    from attendance_tpu.obs.slo import doctor_report
+    old = tmp_path / "old_alerts.jsonl"
+    old.write_text(json.dumps({
+        "ts": 1.0, "slo": "throughput", "state": "firing",
+        "burn_fast": 20.0, "burn_slow": 16.0}) + "\n" + json.dumps({
+            "ts": 2.0, "slo": "throughput", "state": "resolved",
+            "burn_fast": 0.0, "burn_slow": 0.0}) + "\n")
+    text, ok = doctor_report([str(old)])
+    assert ok, text
+    assert text.count("versionless") == 1
+    assert "pre-17 log" in text
+
+    new = tmp_path / "new_alerts.jsonl"
+    new.write_text(json.dumps({
+        "schema": ALERT_SCHEMA, "ts": 1.0, "slo": "throughput",
+        "state": "resolved", "burn_fast": 0.0, "burn_slow": 0.0}) + "\n")
+    text, ok = doctor_report([str(new)])
+    assert ok, text
+    assert "versionless" not in text
+
+
+# -- histogram exemplars (satellite 2) ---------------------------------------
+
+def test_exemplar_worst_observation_wins():
+    from attendance_tpu.obs.registry import Registry
+    reg = Registry()
+    h = reg.histogram("attendance_stage_latency_seconds", stage="decode")
+    h.observe(0.010, "aaaa000000000001")
+    h.observe(0.120, "aaaa000000000002")  # worst traced observation
+    h.observe(0.005, "aaaa000000000003")
+    h.observe(0.500)  # untraced: can never be the exemplar
+    assert h.exemplar(reset=False) == (0.120, "aaaa000000000002")
+
+
+def test_exemplar_rendered_and_parseable():
+    from attendance_tpu.obs.exposition import (
+        format_prom_table, parse_exemplars, parse_prom, render)
+    from attendance_tpu.obs.registry import Registry
+    reg = Registry()
+    h = reg.histogram("attendance_stage_latency_seconds", stage="decode")
+    h.observe(0.020, "deadbeef00000001")
+    text = render(reg)
+    assert ' # {trace_id="deadbeef00000001"} 0.02' in text
+
+    # The exemplar rides the landing cumulative bucket, and the plain
+    # sample value still parses for pre-exemplar consumers.
+    samples = parse_prom(text)
+    for name, _labels, value in samples:
+        float(value)  # every sample stays numeric
+    ex = parse_exemplars(text)
+    key = ("attendance_stage_latency_seconds", 'stage="decode"')
+    assert ex[key] == (0.02, "deadbeef00000001")
+
+    table = format_prom_table(text)
+    assert "exemplar=deadbeef00000001" in table
+
+    # Destructive read: the next scrape window starts fresh.
+    assert " # {" not in render(reg)
+
+
+def test_fast_path_emits_stage_exemplars(tmp_path):
+    """The run loop tags decode/dispatch stage observations with the
+    trace id of the batch, visible on the scrape surface."""
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    from attendance_tpu.obs.exposition import parse_exemplars
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+    config = Config(bloom_filter_capacity=5_000, batch_size=256,
+                    trace_out=str(tmp_path / "trace.json"),
+                    pulsar_topic="exemplar-t").validate()
+    t = obs.enable(config)
+    broker = MemoryBroker()
+    pipe = FusedPipeline(config, client=MemoryClient(broker), num_banks=8)
+    roster, frames = generate_frames(3 * 256, 256, roster_size=1_000,
+                                     seed=3)
+    pipe.preload(roster)
+    producer = MemoryClient(broker).create_producer(config.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(max_events=3 * 256, idle_timeout_s=0.5)
+    ex = parse_exemplars(t.render())
+    stages = {labels for name, labels in ex
+              if name == "attendance_stage_latency_seconds"}
+    assert any('stage="decode"' in s for s in stages)
+    assert any('stage="dispatch"' in s for s in stages)
+    for value, trace_id in ex.values():
+        assert len(trace_id) == 16
+        int(trace_id, 16)
+
+
+# -- striped lanes reach the flight ring (satellite 3) -----------------------
+
+def test_striped_lanes_record_into_flight_ring(tmp_path):
+    """lanes>=1 runs must land per-lane records in the flight ring so
+    a SIGUSR1 dump (same ring) carries lane forensics — previously
+    only the classic loop recorded batches."""
+    from attendance_tpu.pipeline.events import AttendanceEvent, encode_event
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+    dump = tmp_path / "flight.json"
+    config = Config(bloom_filter_capacity=5_000, batch_size=64,
+                    ingress_lanes=2, flight_recorder=64,
+                    flight_path=str(dump),
+                    pulsar_topic="lanes-flight").validate()
+    t = obs.enable(config)
+    rng = np.random.default_rng(5)
+    roster = rng.choice(np.arange(10_000, 60_000, dtype=np.uint32),
+                        300, replace=False)
+    ids = roster[rng.integers(0, len(roster), 256)]
+    payloads = [encode_event(AttendanceEvent(
+        int(ids[i]), "2026-07-14T08:30:00", "LECTURE_20260714",
+        True, "entry")) for i in range(256)]
+    broker = MemoryBroker()
+    pipe = FusedPipeline(config, client=MemoryClient(broker), num_banks=8)
+    pipe.preload(roster)
+    producer = MemoryClient(broker).create_producer(config.pulsar_topic)
+    producer.send_many(payloads)
+    pipe.run(max_events=None, idle_timeout_s=0.5)
+
+    lane_recs = [r for r in t.flight.snapshot()
+                 if isinstance(r, dict) and "lane" in r]
+    assert lane_recs, "striped lanes never reached the flight ring"
+    assert {r["lane"] for r in lane_recs} <= {0, 1}
+    assert all(r.get("events", 0) >= 1 for r in lane_recs)
+    t.dump_flight("test")
+    doc = json.loads(dump.read_text())
+    assert any("lane" in r for r in doc["records"])
+
+
+# -- config / lifecycle wiring -----------------------------------------------
+
+def test_incident_dir_alone_enables_telemetry(tmp_path):
+    config = Config(incident_dir=str(tmp_path / "inc"))
+    assert obs.enabled_in(config)
+    t = obs.enable(config)
+    assert t.incidents is not None
+    assert t.incidents.clear_ticks == 3
+
+
+def test_finalize_persists_open_incident(tmp_path):
+    """Telemetry stop persists a still-open incident with the reason
+    recorded, so a crash-adjacent shutdown never loses the record."""
+    t, eng = _engine(tmp_path)
+    t.registry.gauge("attendance_circuit_state", sink="disk").set(1.0)
+    eng.tick()
+    assert eng.tick() is not None
+    obs.disable()  # runs Telemetry.stop -> incidents.finalize
+    [bundle] = find_bundles(tmp_path / "incidents")
+    rec = json.loads((bundle / "incident.json").read_text())
+    assert rec["detail"]["finalized"] == "telemetry-stop"
+    assert rec["cleared_unix"] is None

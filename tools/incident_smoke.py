@@ -1,0 +1,152 @@
+"""CI incident smoke (ISSUE 17): a chaos run that MUST page.
+
+One fused run with a ``persist_fail`` burst injected under the sink
+breaker: every insert fails, the circuit opens, batches spill to disk
+— the exact correlated breach the incident plane exists to catch.
+
+Gates:
+
+* the :class:`IncidentEngine` opens an incident within ONE evaluation
+  tick of the breach (ticks are driven manually for determinism; the
+  background thread is stopped first);
+* the evidence bundle is complete — all five parts present and
+  verified against the sha256 manifest in ``incident.json``;
+* ``diagnosis.json`` ranks the injected cause first
+  (``persist_sink_down``);
+* ``doctor --incident`` replays the bundle offline and exits 0
+  (open-but-diagnosed is a PASS; incomplete or undiagnosed pages).
+
+The workdir (bundles + prom file + spill dir) ships as a CI triage
+artifact on failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="incident smoke")
+    ap.add_argument("--workdir", default="/tmp/incident_smoke")
+    ap.add_argument("--events", type=int, default=1 << 14)
+    ap.add_argument("--frame-size", type=int, default=2048)
+    args = ap.parse_args()
+
+    work = Path(args.workdir)
+    work.mkdir(parents=True, exist_ok=True)
+    inc_dir = work / "incidents"
+    prom_path = work / "incident.prom"
+
+    from attendance_tpu import chaos, obs
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+    from attendance_tpu.pipeline.loadgen import generate_frames
+
+    obs.disable()
+    chaos.disable()
+    cfg = Config(chaos="persist_fail=1.0", chaos_seed=7,
+                 persist_spill_dir=str(work / "spill"),
+                 persist_breaker_failures=2,
+                 persist_breaker_cooldown_s=600.0,
+                 incident_dir=str(inc_dir),
+                 flight_recorder=64,
+                 metrics_prom=str(prom_path),
+                 wire_format="word", json_chunk_decode=False)
+    chaos.ensure(cfg)
+    telemetry = obs.enable(cfg)
+    # Drive evaluation ticks by hand: the smoke's "within one tick"
+    # gate must not race the 1 Hz background thread.
+    telemetry.incidents.stop()
+    pipe = FusedPipeline(cfg)
+    failures = []
+    try:
+        telemetry.incidents.tick()  # warm-up: baselines the counters
+        roster, frames = generate_frames(
+            args.events, args.frame_size,
+            roster_size=min(cfg.bloom_filter_capacity, args.events),
+            num_lectures=4, seed=17)
+        pipe.preload(roster)
+        producer = pipe.client.create_producer(cfg.pulsar_topic)
+        for f in frames:
+            producer.send(f)
+        pipe.run(max_events=args.events, idle_timeout_s=0.5)
+
+        spilled = pipe.store.spilled_total
+        print(f"[incident_smoke] chaos run done: {spilled} spilled "
+              f"batch(es), breaker state "
+              f"{pipe.store.breaker.state}")
+        if spilled <= 0:
+            failures.append("persist_fail burst spilled nothing "
+                            "(chaos not wired?)")
+
+        iid = telemetry.incidents.tick()  # breach tick
+        if iid is None:
+            failures.append("incident did not open within one "
+                            "evaluation tick of the breach")
+        else:
+            inc = telemetry.incidents._open
+            print(f"[incident_smoke] opened {iid}: "
+                  f"conditions={sorted(inc.conditions)} "
+                  f"top={inc.top_rule}")
+    finally:
+        pipe.cleanup()
+        chaos.disable()
+        obs.disable()  # finalizes the still-open incident record
+
+    # Gate 1: bundle completeness against the sha256 manifest.
+    from attendance_tpu.obs.incident import (
+        EVIDENCE_PARTS, find_bundles, incident_report)
+    try:
+        bundles = find_bundles(inc_dir)
+    except FileNotFoundError:
+        bundles = []
+        failures.append("no incident bundle written")
+    for bundle in bundles:
+        missing = [n for n in EVIDENCE_PARTS + ("diagnosis.json",)
+                   if not (bundle / n).is_file()]
+        if missing:
+            failures.append(f"{bundle.name}: missing evidence "
+                            f"part(s) {missing}")
+
+    # Gate 2: the injected cause is ranked first.
+    if bundles:
+        dx = json.loads((bundles[0] / "diagnosis.json").read_text())
+        top = (dx.get("ranked") or [{}])[0].get("rule")
+        print(f"[incident_smoke] diagnosis top: {top}")
+        if top != "persist_sink_down":
+            failures.append(
+                f"diagnosis ranked {top!r} first, expected "
+                f"'persist_sink_down'")
+
+    # Gate 3: the offline replay verb (exactly the CI-facing form).
+    if bundles:
+        text, ok = incident_report(inc_dir)
+        print(text)
+        if not ok:
+            failures.append("doctor --incident replay FAILED")
+        from attendance_tpu.cli import main as cli_main
+        try:
+            cli_main(["doctor", "--incident", str(inc_dir)])
+            code = 0
+        except SystemExit as exc:
+            code = int(exc.code or 0)
+        if code != 0:
+            failures.append(f"doctor --incident exited {code}")
+
+    if failures:
+        print("[incident_smoke] FAIL")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("[incident_smoke] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
