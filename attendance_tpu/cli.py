@@ -747,7 +747,8 @@ def cmd_doctor(args) -> None:
             sys.exit(2)
         print(text)
         if not args.artifacts and not args.quarantine \
-                and not args.scrub and not args.incident:
+                and not args.scrub and not args.incident \
+                and not args.actuations:
             sys.exit(0 if ok else 1)
         elif not ok:
             # Fall through to the remaining reports, but remember the
@@ -765,7 +766,7 @@ def cmd_doctor(args) -> None:
             sys.exit(2)
         print(text)
         if not args.artifacts and not args.quarantine \
-                and not args.incident:
+                and not args.incident and not args.actuations:
             sys.exit(0 if ok and not getattr(args, "_fleet_failed",
                                              False) else 1)
         elif not ok:
@@ -776,7 +777,12 @@ def cmd_doctor(args) -> None:
         from attendance_tpu.obs.incident import incident_report
 
         try:
-            text, ok = incident_report(args.incident)
+            # With --actuations alongside, each diagnosed bundle also
+            # reports whether the controller's recorded actuation
+            # matched the top-ranked rule's action id.
+            text, ok = incident_report(
+                args.incident,
+                actuation_log=args.actuations or None)
         except FileNotFoundError as e:
             logger.error("no such incident bundle: %s", e)
             sys.exit(2)
@@ -784,13 +790,35 @@ def cmd_doctor(args) -> None:
             logger.error("unreadable incident bundle: %s", e)
             sys.exit(2)
         print(text)
-        if not args.artifacts and not args.quarantine:
+        if not args.artifacts and not args.quarantine \
+                and not args.actuations:
             sys.exit(0 if ok
                      and not getattr(args, "_fleet_failed", False)
                      and not getattr(args, "_scrub_failed", False)
                      else 1)
         elif not ok:
             args._incident_failed = True
+    if args.actuations:
+        # Actuation replay rides the verdict: every control-plane
+        # actuation must be schema-valid with monotonic sequencing —
+        # a log that cannot be replayed cannot explain the run.
+        import os as _os
+
+        from attendance_tpu.control.actuation import actuation_report
+
+        if not _os.path.isfile(args.actuations):
+            logger.error("no such actuation log: %s", args.actuations)
+            sys.exit(2)
+        text, ok = actuation_report(args.actuations)
+        print(text)
+        if not args.artifacts and not args.quarantine:
+            sys.exit(0 if ok
+                     and not getattr(args, "_fleet_failed", False)
+                     and not getattr(args, "_scrub_failed", False)
+                     and not getattr(args, "_incident_failed", False)
+                     else 1)
+        elif not ok:
+            args._actuations_failed = True
     if not args.artifacts and not args.quarantine:
         logger.error("doctor needs artifacts and/or --quarantine DIR")
         sys.exit(2)
@@ -816,7 +844,8 @@ def cmd_doctor(args) -> None:
     print(text)
     if not ok or getattr(args, "_fleet_failed", False) \
             or getattr(args, "_scrub_failed", False) \
-            or getattr(args, "_incident_failed", False):
+            or getattr(args, "_incident_failed", False) \
+            or getattr(args, "_actuations_failed", False):
         sys.exit(1)
 
 
@@ -1085,6 +1114,14 @@ def main(argv=None) -> None:
                        "digests in incident.json and judge the "
                        "diagnosis — exits 1 on an undiagnosed open "
                        "incident or a corrupt/incomplete bundle")
+    p_doc.add_argument("--actuations", default="", metavar="FILE",
+                       help="replay a control-plane actuation log "
+                       "(--control-log JSONL) offline: validate the "
+                       "schema and sequencing of every recorded knob "
+                       "move and print the actuation timeline; with "
+                       "--incident alongside, also report whether the "
+                       "recorded actuations matched each bundle's "
+                       "top-ranked diagnosis action")
     p_doc.add_argument("--scrub", action="append", default=None,
                        metavar="DIR",
                        help="also run the offline integrity scrub "

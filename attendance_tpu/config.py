@@ -404,6 +404,25 @@ class Config:
     persist_spill_dir: str = ""
     persist_breaker_failures: int = 3
     persist_breaker_cooldown_s: float = 1.0
+    # Self-driving control plane (attendance_tpu/control): a controller
+    # thread that actuates bounded knobs (ingress admission, the
+    # degradation ladder, lane scaling, snapshot cadence, watermark/
+    # ring sizing) off the signals the obs plane already measures.
+    # Enabled by control_log — the schema'd JSONL actuation log is the
+    # plane's defining artifact (`doctor --actuations` replays it).
+    control_log: str = ""
+    # When set, shed-rung admission spills raw ingress frames durably
+    # here (checksummed + fsync'd) and acks them; empty = nack back to
+    # the broker (retention is the backpressure).
+    control_spill_dir: str = ""
+    # Minimum seconds between controller moves on the same knob (and
+    # between degradation-ladder rung changes).
+    control_dwell_s: float = 2.0
+    # Consecutive clean controller ticks before de-escalation.
+    control_clear_ticks: int = 3
+    # Max ladder transitions per rolling minute before the controller
+    # holds (anti-flap backstop).
+    control_flap_limit: int = 8
 
     def validate(self) -> "Config":
         if self.sketch_backend not in ("tpu", "memory", "redis",
@@ -524,6 +543,28 @@ class Config:
         if self.incident_clear_ticks <= 0:
             raise ValueError("incident_clear_ticks must be positive "
                              "(clear hysteresis)")
+        if self.slo:
+            # Parse eagerly: an SLO spec with a typo'd stage name used
+            # to sit silently in the registry and never fire — reject
+            # at config time so neither a human nor the controller can
+            # watch a dead objective.
+            from attendance_tpu.obs.slo import parse_slo
+            for spec in self.slo:
+                parse_slo(spec)
+        if self.control_dwell_s <= 0:
+            raise ValueError("control_dwell_s must be positive "
+                             "(per-knob/per-rung dwell minimum)")
+        if self.control_clear_ticks <= 0:
+            raise ValueError("control_clear_ticks must be positive "
+                             "(de-escalation hysteresis)")
+        if self.control_flap_limit <= 0:
+            raise ValueError("control_flap_limit must be positive "
+                             "(transitions per minute cap)")
+        if self.control_spill_dir and not self.control_log:
+            raise ValueError(
+                "control_spill_dir without control_log: the ingress "
+                "spill is an actuation target — enable the control "
+                "plane (and its actuation log) to use it")
         if self.persist_breaker_failures <= 0:
             raise ValueError("persist_breaker_failures must be positive")
         if self.persist_breaker_cooldown_s <= 0:
@@ -789,6 +830,26 @@ def add_flags(parser: Optional[argparse.ArgumentParser] = None
                    default=d.persist_breaker_cooldown_s,
                    help="seconds an open circuit waits before the "
                    "half-open probe")
+    p.add_argument("--control-log", default=d.control_log,
+                   help="enable the self-driving control plane and "
+                   "append its schema'd JSONL actuation log here "
+                   "(replay with `doctor --actuations`)")
+    p.add_argument("--control-spill-dir", default=d.control_spill_dir,
+                   help="shed-rung admission spills raw ingress frames "
+                   "durably here and acks them (empty = nack back to "
+                   "the broker)")
+    p.add_argument("--control-dwell-s", type=float,
+                   default=d.control_dwell_s,
+                   help="minimum seconds between controller moves on "
+                   "the same knob / ladder rung")
+    p.add_argument("--control-clear-ticks", type=int,
+                   default=d.control_clear_ticks,
+                   help="consecutive clean controller ticks before "
+                   "de-escalation")
+    p.add_argument("--control-flap-limit", type=int,
+                   default=d.control_flap_limit,
+                   help="max degradation-ladder transitions per "
+                   "rolling minute before the controller holds")
     p.add_argument("--profile-dir", default=d.profile_dir,
                    help="write a jax.profiler trace of the run here")
     p.add_argument("--profile-hz", type=float, default=d.profile_hz,
@@ -917,6 +978,11 @@ def config_from_args(args: argparse.Namespace) -> Config:
         persist_spill_dir=args.persist_spill_dir,
         persist_breaker_failures=args.persist_breaker_failures,
         persist_breaker_cooldown_s=args.persist_breaker_cooldown_s,
+        control_log=args.control_log,
+        control_spill_dir=args.control_spill_dir,
+        control_dwell_s=args.control_dwell_s,
+        control_clear_ticks=args.control_clear_ticks,
+        control_flap_limit=args.control_flap_limit,
         profile_dir=args.profile_dir,
         profile_hz=args.profile_hz,
         profile_out=args.profile_out,

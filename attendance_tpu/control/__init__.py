@@ -1,0 +1,29 @@
+"""attendance_tpu.control — the self-driving control plane.
+
+Closes the sense→act loop: the observability plane (SLO burn rates,
+lane skew, staleness, merge lag, attribution, incidents) already sees
+every failure mode; this package gives the process bounded, logged,
+hysteresis-guarded ways to RESPOND — ingress admission control, a
+graceful-degradation ladder, dynamic lane scaling, snapshot-cadence and
+watermark adaptation — all under the standing invariants: no
+acked-event loss, state oracle-equal to an uncontrolled run over the
+same acked frames, zero steady-state recompiles (shape actuations pick
+only from pre-warmed ladders), bounded flapping.
+
+Enabled by ``--control-log PATH`` (the schema'd JSONL actuation log is
+the plane's defining artifact; ``doctor --actuations`` replays it).
+"""
+
+from .actuation import (ACTUATION_SCHEMA, ActuationLog,
+                        actuation_report, read_actuations,
+                        validate_actuation)
+from .engine import ADVISORY_ACTIONS, ControlEngine, IngressAdmission
+from .knobs import Knob, KnobBoard, Proposal
+from .ladder import RUNGS, DegradationLadder
+
+__all__ = [
+    "ACTUATION_SCHEMA", "ADVISORY_ACTIONS", "ActuationLog",
+    "ControlEngine", "DegradationLadder", "IngressAdmission", "Knob",
+    "KnobBoard", "Proposal", "RUNGS", "actuation_report",
+    "read_actuations", "validate_actuation",
+]

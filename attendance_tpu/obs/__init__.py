@@ -141,7 +141,8 @@ def enabled_in(config) -> bool:
                 or getattr(config, "slo", None)
                 or getattr(config, "fleet_push", "")
                 or getattr(config, "profile_hz", 0.0)
-                or getattr(config, "incident_dir", ""))
+                or getattr(config, "incident_dir", "")
+                or getattr(config, "control_log", ""))
 
 
 class Telemetry:
@@ -160,7 +161,12 @@ class Telemetry:
                  metric_series_max: int = 1024,
                  profile_hz: float = 0.0, profile_out: str = "",
                  incident_dir: str = "",
-                 incident_clear_ticks: int = 3):
+                 incident_clear_ticks: int = 3,
+                 control_log: str = "",
+                 control_spill_dir: str = "",
+                 control_dwell_s: float = 2.0,
+                 control_clear_ticks: int = 3,
+                 control_flap_limit: int = 8):
         self.registry = Registry(max_series=metric_series_max)
         self.flight: Optional[FlightRecorder] = (
             FlightRecorder(flight_recorder) if flight_recorder > 0
@@ -226,6 +232,21 @@ class Telemetry:
                 instance=fleet_instance,
                 clear_ticks=incident_clear_ticks,
                 interval_s=min(metrics_interval_s, 1.0))
+        # Control plane (attendance_tpu/control): the actuation engine
+        # consumes every signal constructed above (slo, recompiles,
+        # incidents) and mutates only bounded knobs a pipeline binds at
+        # attach() time. Created LAST so its first tick sees the full
+        # bundle.
+        self.control = None
+        if control_log:
+            from attendance_tpu.control.engine import ControlEngine
+            self.control = ControlEngine(
+                self, control_log,
+                spill_dir=control_spill_dir,
+                dwell_s=control_dwell_s,
+                clear_ticks=control_clear_ticks,
+                flap_limit=control_flap_limit,
+                interval_s=min(metrics_interval_s, 1.0))
         self._reporter = None
         self._server = None
         self._prev_sigusr1 = _NOT_INSTALLED
@@ -263,6 +284,10 @@ class Telemetry:
             self.incidents.start()
         if self.profiler is not None:
             self.profiler.start()
+        if self.control is not None:
+            # After the incident engine: an actuation's incident id
+            # must come from a tick that already saw the conditions.
+            self.control.start()
         if self._fleet_push:
             from attendance_tpu.obs.fleet import (
                 FleetPusher, default_instance)
@@ -274,7 +299,8 @@ class Telemetry:
                 interval_s=self._fleet_interval).start()
         if (self.tracer is not None or self._reporter is not None
                 or self.slo is not None or self.profiler is not None
-                or self.incidents is not None):
+                or self.incidents is not None
+                or self.control is not None):
             # Backstop for CLI runs that never reach a run-loop flush
             # (KeyboardInterrupt, runs shorter than the reporter
             # interval); every flush is idempotent. ONE module-level
@@ -289,6 +315,10 @@ class Telemetry:
 
     def stop(self) -> None:
         self.flush_trace("telemetry-stop")
+        if self.control is not None:
+            # The controller stops FIRST: it must not actuate against
+            # signal sources that the teardown below is dismantling.
+            self.control.stop()
         if self.incidents is not None:
             # Persist a still-open incident record while every evidence
             # source below is alive, then stop the tick thread.
@@ -455,7 +485,13 @@ def enable(config) -> Telemetry:
             profile_out=getattr(config, "profile_out", ""),
             incident_dir=getattr(config, "incident_dir", ""),
             incident_clear_ticks=getattr(config, "incident_clear_ticks",
-                                         3))
+                                         3),
+            control_log=getattr(config, "control_log", ""),
+            control_spill_dir=getattr(config, "control_spill_dir", ""),
+            control_dwell_s=getattr(config, "control_dwell_s", 2.0),
+            control_clear_ticks=getattr(config, "control_clear_ticks",
+                                        3),
+            control_flap_limit=getattr(config, "control_flap_limit", 8))
         t.start()
         TELEMETRY = t
         return t

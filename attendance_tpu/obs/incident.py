@@ -86,6 +86,11 @@ class Rule:
     required: Tuple[str, ...]
     optional: Tuple[str, ...] = ()
     evidence: Tuple[str, ...] = ()
+    #: Stable actuation id the control plane maps to a policy (an id in
+    #: control.engine.ADVISORY_ACTIONS has no knob by design — shape
+    #: pinning is a standing gate, rebalance is ROADMAP item 3, wire
+    #: quarantine already happened by diagnosis time).
+    action: str = ""
 
 
 RULES: Tuple[Rule, ...] = (
@@ -95,6 +100,7 @@ RULES: Tuple[Rule, ...] = (
         required=("circuit_open", "spill_growth"),
         optional=("slo_burn",),
         evidence=("metrics.prom", "flight.json"),
+        action="shed_ingress",
     ),
     Rule(
         "shape_churn",
@@ -102,6 +108,7 @@ RULES: Tuple[Rule, ...] = (
         required=("steady_recompiles",),
         optional=("throughput_drop", "dispatch_gap"),
         evidence=("attribution.json", "metrics.prom"),
+        action="pin_shapes",
     ),
     Rule(
         "dead_worker",
@@ -109,6 +116,7 @@ RULES: Tuple[Rule, ...] = (
         required=("peer_down",),
         optional=("merge_lag", "slo_burn"),
         evidence=("fleet_status.json", "metrics.prom"),
+        action="defer_rebalance",
     ),
     Rule(
         "temporal_dispatch_pass",
@@ -117,6 +125,7 @@ RULES: Tuple[Rule, ...] = (
         required=("throughput_drop", "stage_shift"),
         optional=("dispatch_gap",),
         evidence=("attribution.json", "trace_slice.json"),
+        action="pause_temporal",
     ),
     Rule(
         "fed_merge_backlog",
@@ -124,6 +133,7 @@ RULES: Tuple[Rule, ...] = (
         required=("merge_lag",),
         optional=("slo_burn",),
         evidence=("metrics.prom", "fleet_status.json"),
+        action="stretch_snapshot_cadence",
     ),
     Rule(
         "stale_reads",
@@ -131,6 +141,7 @@ RULES: Tuple[Rule, ...] = (
         required=("read_staleness",),
         optional=("slo_burn",),
         evidence=("metrics.prom", "flight.json"),
+        action="tighten_snapshot_cadence",
     ),
     Rule(
         "watermark_stall",
@@ -138,6 +149,7 @@ RULES: Tuple[Rule, ...] = (
         required=("watermark_lag",),
         optional=("throughput_drop",),
         evidence=("metrics.prom", "trace_slice.json"),
+        action="widen_lateness",
     ),
     Rule(
         "lane_stall",
@@ -145,6 +157,7 @@ RULES: Tuple[Rule, ...] = (
         required=("lane_stall",),
         optional=("throughput_drop",),
         evidence=("flight.json", "metrics.prom"),
+        action="rescale_lanes",
     ),
     Rule(
         "sink_circuit_open",
@@ -152,6 +165,7 @@ RULES: Tuple[Rule, ...] = (
         required=("circuit_open",),
         optional=("slo_burn",),
         evidence=("metrics.prom", "flight.json"),
+        action="shed_ingress",
     ),
     Rule(
         "wire_rot",
@@ -159,12 +173,14 @@ RULES: Tuple[Rule, ...] = (
         required=("integrity_rejects",),
         optional=("throughput_drop",),
         evidence=("metrics.prom", "flight.json"),
+        action="quarantine_only",
     ),
     Rule(
         "slo_burn",
         "error-budget burn: SLO firing without a correlated secondary signal",
         required=("slo_burn",),
         evidence=("metrics.prom", "flight.json"),
+        action="escalate_ladder",
     ),
     Rule(
         "dispatch_gap",
@@ -172,6 +188,7 @@ RULES: Tuple[Rule, ...] = (
         required=("dispatch_gap",),
         optional=("throughput_drop",),
         evidence=("attribution.json", "trace_slice.json"),
+        action="resize_dispatch",
     ),
 )
 
@@ -197,6 +214,7 @@ def diagnose(conditions) -> List[Dict[str, Any]]:
                 "score": 2 * len(rule.required) + len(opt),
                 "matched": sorted(set(rule.required) | set(opt)),
                 "evidence": list(rule.evidence),
+                "action": rule.action,
             }
         )
     ranked.sort(key=lambda r: (-r["score"], r["rule"]))
@@ -941,16 +959,42 @@ def _verify_part(bundle: Path, name: str, expected: str) -> Tuple[str, bool]:
     return "sha256 ok", True
 
 
-def incident_report(path) -> Tuple[str, bool]:
+def _actuation_matches(action: str, rec: Dict[str, Any]) -> bool:
+    """Does one actuation record satisfy a diagnosis rule's action id?
+    ``escalate_ladder`` is satisfied by any escalating ladder move."""
+
+    if rec.get("action") == action:
+        return True
+    return (
+        action == "escalate_ladder"
+        and rec.get("policy") == "degradation_ladder"
+        and rec.get("direction") == "escalate"
+    )
+
+
+def incident_report(path, actuation_log=None) -> Tuple[str, bool]:
     """Replay bundles offline into the doctor verdict table.
 
     Returns ``(text, ok)``. ``ok`` is False when any bundle is incomplete,
     fails digest verification, or holds an *undiagnosed open* incident.
     Raises ``FileNotFoundError``/``ValueError`` for unreadable input so the
     CLI can exit 2 rather than report a false verdict.
+
+    ``actuation_log`` (a control-plane JSONL path) adds a row per
+    diagnosed bundle saying whether the controller's recorded actuation
+    matched the top-ranked rule's ``action`` id (advisory actions have
+    no knob by design and report as such). Mismatches are warnings, not
+    failures: a bundle may predate the controller, or the controller
+    may legitimately have acted on a lower-ranked rule first.
     """
 
     from .exposition import _table
+
+    actuations: List[Dict[str, Any]] = []
+    if actuation_log is not None:
+        from attendance_tpu.control.actuation import read_actuations
+
+        actuations, _problems = read_actuations(str(actuation_log))
 
     bundles = find_bundles(path)
     rows: List[List[str]] = []
@@ -1010,6 +1054,41 @@ def incident_report(path) -> Tuple[str, bool]:
                         "info",
                     ]
                 )
+                action = str(first.get("action") or "")
+                if actuation_log is not None and action:
+                    from attendance_tpu.control.engine import (
+                        ADVISORY_ACTIONS,
+                    )
+
+                    mine = [a for a in actuations if a.get("incident") == iid]
+                    if action in ADVISORY_ACTIONS:
+                        rows.append(
+                            [
+                                f"{iid} actuation",
+                                f"{action}: advisory (no knob)",
+                                "-",
+                                "info",
+                            ]
+                        )
+                    elif any(_actuation_matches(action, a) for a in mine):
+                        rows.append(
+                            [
+                                f"{iid} actuation",
+                                f"matched top rule ({action})",
+                                action,
+                                "PASS",
+                            ]
+                        )
+                    else:
+                        rows.append(
+                            [
+                                f"{iid} actuation",
+                                f"no recorded actuation for {action} "
+                                f"({len(mine)} record(s) for incident)",
+                                action,
+                                "warn",
+                            ]
+                        )
     ok = breached == 0
     lines = [
         f"incident replay: {len(bundles)} bundle(s) under {path}",

@@ -97,6 +97,16 @@ DEFAULT_SLOS = (
 
 _STAGE_ALIAS = {"dequeue": "dequeue_wait", "device": "device_wait",
                 "assembly": "batch_assembly"}
+# Every stage name the pipelines actually time (grep `.stage("...")`).
+# A quantile spec naming anything else would sit in the registry and
+# never fire — reject it at parse (= config) time instead: a dead
+# objective is worse than none, because a human (or the controller)
+# believes it is being watched.
+KNOWN_STAGES = frozenset({
+    "dequeue_wait", "decode", "dispatch", "device_wait",
+    "snapshot_write", "snapshot_blocked", "batch_assembly", "sketch",
+    "persist", "query",
+})
 _QUANTILE_RE = re.compile(r"^([a-z_]+)_p(\d{1,2})$")
 
 
@@ -153,6 +163,10 @@ def parse_slo(spec: str) -> Slo:
     m = _QUANTILE_RE.match(alias)
     if m:
         stage = _STAGE_ALIAS.get(m.group(1), m.group(1))
+        if stage not in KNOWN_STAGES:
+            raise ValueError(
+                f"unknown stage {stage!r} in SLO spec {spec!r} "
+                f"(known stages: {', '.join(sorted(KNOWN_STAGES))})")
         return Slo(alias, "quantile",
                    "attendance_stage_latency_seconds", op, threshold,
                    label_filter=("stage", stage),

@@ -756,6 +756,21 @@ class FusedPipeline:
                     and getattr(self._obs, "_server", None) is not None):
                 from attendance_tpu.serve import http as serve_http
                 serve_http.attach(self._obs._server, self.query_engine)
+        # Control-plane knobs (attendance_tpu/control). The attributes
+        # exist unconditionally — the hot path branches on them whether
+        # or not a controller is attached; without one they are the
+        # configured constants. `_audit_every` widens the audit shadow's
+        # frame interval under ladder rung >= 1; `_temporal_paused`
+        # gates the temporal host pass under rung >= 3.
+        self._audit_every = 1
+        self._temporal_paused = False
+        self._admission = None
+        self._admission_retire: list = []
+        self._control = (getattr(self._obs, "control", None)
+                         if self._obs is not None else None)
+        if self._control is not None:
+            self._control.attach(self)
+            self._admission = self._control.admission
 
     _LUT_SIZE = 1 << 14  # covers ~44 years of calendar days from base
     _TRACE_ROLE = "fused-pipeline"
@@ -1061,10 +1076,15 @@ class FusedPipeline:
             # BEFORE dispatch, so even native packs that never
             # materialize a host bank array are covered.
             self._note_dirty(cols["lecture_day"])
-        if self._auditor is not None:
+        if self._auditor is not None and (
+                self._audit_every <= 1
+                or self.metrics.batches % self._audit_every == 0):
             # Shadow recording only — no device read, no sync; the
             # sampled ~1% of lanes feed the scrape-time measured
             # FPR / HLL-error callbacks (obs/audit.register_fused_audit).
+            # Under degradation-ladder rung >= 1 the controller widens
+            # `_audit_every` so the shadow thins to every Nth frame —
+            # the measured gauges stay live, just over a sparser sample.
             self._auditor.observe_fused_frame(cols["student_id"],
                                               cols["lecture_day"])
         if self.sharded:
@@ -1134,7 +1154,7 @@ class FusedPipeline:
             # columns, off the wire's critical path.)
             cols = {k: np.array(v) for k, v in cols.items()}
         t_tmp = 0.0
-        if self._temporal is not None:
+        if self._temporal is not None and not self._temporal_paused:
             # Temporal sidecar: windowed adds dispatch with this
             # frame (order-free scatter-max, same ack barrier); the
             # reorder stage feeds the order-sensitive consumers.
@@ -2823,6 +2843,7 @@ class FusedPipeline:
         if not self.sharded:
             self._checkpoint_async(force=True)  # acks when durable
             self._flush_snapshots()
+            self._retire_spilled()
             return
         if self._snap_mode == "delta" and not self._base_stale:
             self._snapshot_sync_delta()
@@ -2831,6 +2852,7 @@ class FusedPipeline:
         acknowledge_all(self.consumer,
                         [m for m, _, _ in self._inflight])
         self._inflight.clear()
+        self._retire_spilled()
 
     def _snapshot_sync_delta(self) -> None:
         """Mesh-path incremental barrier: merge + gather ONLY the
@@ -2855,6 +2877,46 @@ class FusedPipeline:
                                      dict(self._bank_of),
                                      self._events_total,
                                      self.engine.num_banks)
+
+    # -- ingress-spill draining (control plane) -----------------------------
+    def _drain_admission(self, limit: int = 16) -> int:
+        """Replay up to ``limit`` admission-spilled frames through the
+        normal frame path (dispatch thread only). Files are queued for
+        retirement at the next snapshot barrier — crash in between
+        re-adopts them next run (at-least-once, the same contract
+        broker redelivery imposes)."""
+        adm = self._admission
+        if adm is None:
+            return 0
+        batch = adm.drain_batch(limit)
+        for path, payload in batch:
+            try:
+                self.process_frame(payload)
+            except Exception:
+                # A frame that poisons on replay poisons forever: park
+                # it aside (same quarantine posture as handle_poison)
+                # rather than livelock the drain.
+                logger.exception("Bad spilled frame %s", path)
+                try:
+                    path.rename(path.with_suffix(".poison"))
+                except OSError:
+                    pass
+                continue
+            self._admission_retire.append(path)
+        if not self.checkpointing and self._admission_retire:
+            # No barriers in this mode: processed is as durable as the
+            # pipeline ever gets, so retire immediately.
+            adm.retire(self._admission_retire)
+            self._admission_retire.clear()
+        return len(batch)
+
+    def _retire_spilled(self) -> None:
+        """Delete ingress-spill files whose replayed events the barrier
+        that just completed now covers (durability handoff:
+        spill file -> snapshot chain)."""
+        if self._admission is not None and self._admission_retire:
+            self._admission.retire(self._admission_retire)
+            self._admission_retire.clear()
 
     # -- ack draining -------------------------------------------------------
     def _drain_inflight(self, block: int = 0) -> None:
@@ -2936,6 +2998,12 @@ class FusedPipeline:
             if self._obs is not None:
                 self._obs.dump_flight("run-loop-exception")
             raise
+        if self._admission is not None and self._admission.pending_count:
+            # Every spilled frame was ACKED against its spill file's
+            # durability — it must reach the sketch state (and the
+            # final snapshot barrier below) before this run ends.
+            while self._drain_admission(limit=64):
+                pass
         if self._temporal is not None:
             # End of run: release the reorder buffer, rotate final
             # buckets, fold the staged CMS estimates. Before the
@@ -2943,7 +3011,10 @@ class FusedPipeline:
             # lands in the last manifest.
             self._temporal.flush()
         if self.checkpointing:
-            if self._inflight:
+            if self._inflight or self._admission_retire:
+                # Replayed spill frames force a barrier even with no
+                # broker in-flight: their files may only retire once a
+                # snapshot covers their events.
                 self._checkpoint_and_ack()  # flushes the writer first
             else:
                 self._flush_snapshots()  # acks from the last barrier
@@ -3017,10 +3088,43 @@ class FusedPipeline:
                 if self.checkpointing and self._inflight:
                     self._checkpoint_and_ack()
                 self._drain_inflight(block=-1)
+                if (self._admission is not None
+                        and not self._admission.active
+                        and self._admission.pending_count):
+                    # Pressure cleared with frames parked in the
+                    # ingress spill: replay them on THIS thread
+                    # (process_frame is dispatch-thread-only). Their
+                    # files retire at the next snapshot barrier.
+                    if self._drain_admission(limit=16):
+                        idle_since = time.monotonic()  # progress
+                    if (self.checkpointing
+                            and self.metrics.batches
+                            - self._batches_at_snap >= self._snap_every):
+                        self._checkpoint_and_ack()
+                    continue
                 if time.monotonic() - idle_since > idle_timeout_s:
                     break
                 continue
             idle_since = time.monotonic()
+            adm = self._admission
+            if adm is not None and adm.active:
+                # Admission control (control plane, shed rung): the
+                # producer-facing edge. "spill" wrote the raw frame
+                # durably (checksummed + fsync'd) — that durability is
+                # what justifies the ack; "shed" nacks, so the broker's
+                # retention is the backpressure. Either way the frame
+                # skips decode/dispatch entirely: under pressure the
+                # snapshot cadence (and with it read staleness) holds
+                # instead of collapsing.
+                decision = adm.admit(msg.data())
+                if decision == "spill":
+                    self.consumer.acknowledge(msg)
+                    continue
+                if decision == "shed":
+                    self.consumer.negative_acknowledge(msg)
+                    continue
+                # "pass": the controller re-opened between the check
+                # and the admit — process normally.
             span = (self._begin_batch_span(msg, t_rx, t_got)
                     if self._tracer is not None else None)
             try:

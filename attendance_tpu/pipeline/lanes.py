@@ -147,6 +147,7 @@ class IngressLane:
         self.consumer = consumer
         self.config = config
         self.queue = _LaneQueue(queue_depth, wake or threading.Event())
+        self._paused = threading.Event()
         self._batch = batch_size
         self._stop = stop
         self._obs = obs
@@ -303,11 +304,29 @@ class IngressLane:
             self._ev_per_msg = max(1, total_events // total_msgs)
         return chunks
 
+    def pause(self) -> None:
+        """Park this lane (control-plane lane scaling): the worker
+        stops receiving/decoding but keeps draining settlements — acks
+        for already-dispatched blocks must still reach the broker.
+        Frames stay in the broker (never received), so pausing loses
+        nothing; blocks already queued still pop via the dispatcher."""
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    @property
+    def paused(self) -> bool:
+        return self._paused.is_set()
+
     def _run(self) -> None:
         if self._stage_mark is not None:
             self._stage_mark.set("lane_decode")
         while not self._stop.is_set():
             self._drain_settlements()
+            if self._paused.is_set():
+                time.sleep(0.05)
+                continue
             chunks = None
             try:
                 if self._chunk_lane:
@@ -739,6 +758,29 @@ class StripedConsumer:
                 wake=self._wake))
         for lane in self.lanes:
             lane.thread.start()
+
+    # -- control-plane knob surface -----------------------------------------
+    @property
+    def active_lanes(self) -> int:
+        return sum(1 for lane in self.lanes if not lane.paused)
+
+    def set_active_lanes(self, n: int) -> None:
+        """Run the first ``n`` lanes, park the rest (clamped to
+        [1, len(lanes)]). Parked lanes keep settling acks; their queued
+        blocks still drain through the dispatcher."""
+        n = max(1, min(int(n), len(self.lanes)))
+        for i, lane in enumerate(self.lanes):
+            if i < n:
+                lane.resume()
+            else:
+                lane.pause()
+
+    def set_dispatch_size(self, n: int) -> None:
+        """Retarget the coalesce size. Callers are expected to pick
+        from the pre-warmed power-of-two pad ladder (the control
+        plane's shape-safety contract enforces this at the knob layer);
+        the dispatcher itself only needs a positive int."""
+        self._dispatch_size = max(1, int(n))
 
     # -- dispatcher ---------------------------------------------------------
     def _pop_ready(self) -> List["_Block"]:

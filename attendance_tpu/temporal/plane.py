@@ -335,6 +335,29 @@ class TemporalPlane:
             self._c_dwell.inc(len(dwell_us))
 
     # -- liveness ------------------------------------------------------------
+    # -- control-plane knobs -------------------------------------------------
+    def widen_lateness(self, lateness_us: int) -> None:
+        """GROW the allowed-lateness budget (control plane, late-drop
+        adaptation). Widening is always safe mid-stream: the watermark
+        only trails further, so events buffer longer and fewer arrive
+        behind it — no event that would have been released on time can
+        now drop. Shrinking mid-stream could jump the watermark forward
+        over buffered events, so it is refused here (the knob's lower
+        bound is the configured value for the same reason)."""
+        lateness_us = int(lateness_us)
+        if lateness_us > self.reorder.lateness_us:
+            self.reorder.lateness_us = lateness_us
+
+    def grow_ring(self, capacity: int) -> None:
+        """GROW the bucket-ring capacity (control plane, late-drop
+        adaptation). Grow-only: eviction triggers on len >= capacity,
+        so raising it mid-stream just delays the next eviction;
+        shrinking would strand already-allocated buckets past the new
+        bound and is refused."""
+        capacity = int(capacity)
+        if capacity > self.ring.capacity:
+            self.ring.capacity = capacity
+
     def maybe_idle_flush(self) -> bool:
         """Watermark idle advancement: silent past --watermark-idle-s
         with events buffered -> release everything and rotate to the

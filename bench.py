@@ -726,6 +726,26 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
                                 if incident_ticks_live else 0)
         finally:
             obs.disable()
+    # Control plane (ISSUE 20): everything the audited stage runs PLUS
+    # the live controller — a 1 Hz signal-evaluation tick over the
+    # registry with the actuation log open. A healthy benchmark run
+    # presents no pressure, so the honest claim is twofold: the tick
+    # thread costs ~nothing AND the controller actuates NOTHING
+    # (actuations_fired must be 0 — a controller that fiddles knobs
+    # during a clean steady-state run is itself a defect).
+    with tempfile.TemporaryDirectory() as tdir:
+        t_ctl = obs.enable(Config(
+            flight_recorder=256,
+            trace_out=os.path.join(tdir, "trace.json"),
+            audit_sample=0.01,
+            control_log=os.path.join(tdir, "actuations.jsonl")))
+        try:
+            controlled = bench_e2e(batch_size, seconds, capacity,
+                                   num_banks)
+            actuations_fired = (t_ctl.control.actuations_total
+                                if t_ctl.control is not None else 0)
+        finally:
+            obs.disable()
     # Profiling plane (ISSUE 15): everything the audited stage runs
     # PLUS the host sampling profiler at 29 Hz with artifacts on. The
     # measured run's own attribution (stage self-time fractions,
@@ -819,6 +839,7 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
     traced_frac = 1.0 - traced["events_per_sec"] / base
     audited_frac = 1.0 - audited["events_per_sec"] / base
     incident_frac = 1.0 - incident["events_per_sec"] / base
+    control_frac = 1.0 - controlled["events_per_sec"] / base
     profiled_frac = 1.0 - profiled["events_per_sec"] / base
     fleet_frac = 1.0 - fleet["events_per_sec"] / base
     chaos_frac = 1.0 - chaos_off["events_per_sec"] / base
@@ -872,6 +893,25 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
             incident_frac <= 0.02 if (os.cpu_count() or 1) > 2
             else (1.0 - incident["events_per_sec"]
                   / max(audited["events_per_sec"], 1e-9)) <= 0.10),
+        # Controller-on column (ISSUE 20): the audited stage plus the
+        # live control engine (1 Hz signal tick + actuation log).
+        # Host-scaled exactly like the incident gate, and additionally
+        # benign-by-construction: a clean run must record ZERO
+        # actuations.
+        "control_events_per_sec": round(
+            controlled["events_per_sec"], 1),
+        "control_overhead_frac": round(control_frac, 4),
+        "actuations_fired": actuations_fired,
+        "control_gate": ("<=2% vs disabled, 0 actuations"
+                         if (os.cpu_count() or 1) > 2
+                         else "<=10% vs audited, 0 actuations "
+                         "(<=2-core host: co-hosted control tick)"),
+        "control_guardrail_pass": (
+            actuations_fired == 0
+            and (control_frac <= 0.02 if (os.cpu_count() or 1) > 2
+                 else (1.0 - controlled["events_per_sec"]
+                       / max(audited["events_per_sec"], 1e-9))
+                 <= 0.10)),
         # Profiling-on column (ISSUE 15): the audited stage plus the
         # 29 Hz sampling profiler. Host-scaled like the fleet/
         # integrity gates: on >2-core hosts the sampler rides a spare
@@ -948,6 +988,7 @@ def bench_obs_overhead(batch_size: int, seconds: float, capacity: int,
         "traced_rates": traced["rates"],
         "audited_rates": audited["rates"],
         "incident_rates": incident["rates"],
+        "control_rates": controlled["rates"],
         "profiled_rates": profiled["rates"],
         "fleet_rates": fleet["rates"],
         "chaos_off_rates": chaos_off["rates"],
@@ -3057,6 +3098,7 @@ def main() -> None:
                    ("disabled_events_per_sec", "enabled_events_per_sec",
                     "traced_events_per_sec", "audited_events_per_sec",
                     "incident_events_per_sec",
+                    "control_events_per_sec",
                     "profiled_events_per_sec",
                     "fleet_events_per_sec",
                     "chaos_off_events_per_sec",
@@ -3065,6 +3107,8 @@ def main() -> None:
                     "guardrail_gate", "guardrail_pass",
                     "incident_overhead_frac", "incidents_opened",
                     "incident_gate", "incident_guardrail_pass",
+                    "control_overhead_frac", "actuations_fired",
+                    "control_gate", "control_guardrail_pass",
                     "profile_overhead_frac", "profile_hz",
                     "profile_gate", "profile_guardrail_pass",
                     "attribution",
@@ -3079,7 +3123,7 @@ def main() -> None:
                     "integrity_guardrail_pass",
                     "disabled_rates", "enabled_rates",
                     "traced_rates", "audited_rates",
-                    "incident_rates",
+                    "incident_rates", "control_rates",
                     "profiled_rates", "fleet_rates",
                     "chaos_off_rates",
                     "converged", "wire", "device")},

@@ -1,0 +1,527 @@
+"""Control-plane tests (ISSUE 20): the degradation-ladder state
+machine (escalation/de-escalation ordering, dwell minimums, anti-flap
+hysteresis), knob bounds/ladder safety (out-of-ladder shapes refused
+and counted), the actuation-log schema round-trip, engine-level rung
+application over a live registry, ingress admission spill/drain, the
+SLO stage-name validation fix, and ``doctor --actuations``."""
+
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from attendance_tpu import chaos, obs
+from attendance_tpu.config import Config
+from attendance_tpu.control import (
+    ACTUATION_SCHEMA,
+    ActuationLog,
+    ControlEngine,
+    DegradationLadder,
+    IngressAdmission,
+    Knob,
+    KnobBoard,
+    RUNGS,
+    actuation_report,
+    read_actuations,
+)
+from attendance_tpu.obs.incident import RULES, _actuation_matches, diagnose
+from attendance_tpu.obs.slo import parse_slo
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    chaos.disable()
+    obs.disable()
+    yield
+    chaos.disable()
+    obs.disable()
+
+
+class Clock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# DegradationLadder state machine
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_escalates_monotonically_in_order():
+    clk = Clock()
+    lad = DegradationLadder(dwell_s=1.0, escalate_ticks=2,
+                            clear_ticks=2, clock=clk)
+    seen = []
+    for _ in range(40):
+        clk.t += 1.0
+        moved = lad.tick(True)
+        if moved is not None:
+            seen.append(moved)
+        if lad.rung == len(RUNGS) - 1:
+            break
+    # One rung at a time, strictly in ladder order, never skipping.
+    assert seen == [1, 2, 3, 4]
+    assert lad.mode == "shed"
+    # Saturated: more pressure never overshoots.
+    clk.t += 10.0
+    assert lad.tick(True) is None
+    assert lad.rung == 4
+
+
+def test_ladder_deescalates_in_reverse_order():
+    clk = Clock()
+    lad = DegradationLadder(dwell_s=0.5, escalate_ticks=1,
+                            clear_ticks=2, clock=clk)
+    while lad.rung < 4:
+        clk.t += 1.0
+        lad.tick(True)
+    seen = []
+    for _ in range(40):
+        clk.t += 1.0
+        moved = lad.tick(False)
+        if moved is not None:
+            seen.append(moved)
+        if lad.rung == 0:
+            break
+    assert seen == [3, 2, 1, 0]
+    # Stable at normal.
+    clk.t += 5.0
+    assert lad.tick(False) is None
+
+
+def test_ladder_dwell_minimum_blocks_fast_transitions():
+    clk = Clock()
+    lad = DegradationLadder(dwell_s=10.0, escalate_ticks=1,
+                            clear_ticks=1, clock=clk)
+    clk.t += 0.1
+    assert lad.tick(True) == 1  # ladder starts settled: first is free
+    # Streak satisfied but dwell not: held at rung 1.
+    for _ in range(5):
+        clk.t += 1.0
+        assert lad.tick(True) is None
+    assert lad.rung == 1
+    clk.t += 10.0
+    assert lad.tick(True) == 2
+
+
+def test_ladder_transition_consumes_streak():
+    clk = Clock()
+    lad = DegradationLadder(dwell_s=0.1, escalate_ticks=3,
+                            clear_ticks=3, clock=clk)
+    for i in range(3):
+        clk.t += 1.0
+        moved = lad.tick(True)
+    assert moved == 1
+    # The NEXT escalation needs a fresh 3-tick pressure streak even
+    # though dwell has long passed.
+    clk.t += 1.0
+    assert lad.tick(True) is None
+    clk.t += 1.0
+    assert lad.tick(True) is None
+    clk.t += 1.0
+    assert lad.tick(True) == 2
+
+
+def test_ladder_flap_limit_holds():
+    clk = Clock()
+    lad = DegradationLadder(dwell_s=0.01, escalate_ticks=1,
+                            clear_ticks=1, flap_limit=3, clock=clk)
+    # Alternate pressure/clean fast enough to flap; all transitions
+    # stay inside one 60 s window.
+    transitions = 0
+    for i in range(20):
+        clk.t += 0.1
+        if lad.tick(i % 2 == 0) is not None:
+            transitions += 1
+    assert transitions == 3  # capped by flap_limit
+    assert lad.flap_holds > 0
+    # Window expiry re-arms the ladder.
+    clk.t += 61.0
+    assert lad.tick(True) is not None
+
+
+# ---------------------------------------------------------------------------
+# Knob safety envelopes
+# ---------------------------------------------------------------------------
+
+
+def test_knob_clamps_to_bounds_and_counts():
+    state = {"v": 10}
+    k = Knob("snap", lambda: state["v"],
+             lambda v: state.__setitem__("v", v), lo=4, hi=64)
+    p = k.propose(1000)
+    assert p.outcome == "clamped" and p.applied == 64
+    assert state["v"] == 64
+    p = k.propose(1)
+    assert p.outcome == "clamped" and p.applied == 4
+    assert state["v"] == 4
+    assert k.clamped_total == 2
+    p = k.propose(32)
+    assert p.outcome == "applied" and state["v"] == 32
+    assert k.propose(32).outcome == "noop"
+
+
+def test_shape_knob_refuses_out_of_ladder():
+    state = {"v": 1024}
+    k = Knob("dispatch_size", lambda: state["v"],
+             lambda v: state.__setitem__("v", v),
+             ladder=(256, 512, 1024), shape_safe=True)
+    p = k.propose(300)  # NOT a pre-warmed shape
+    assert p.outcome == "refused" and p.applied is None
+    assert state["v"] == 1024  # setter never ran
+    assert k.refused_total == 1
+    assert k.propose(512).outcome == "applied"
+    assert k.step(+1) == 1024 and state["v"] == 512
+    assert k.step(-1) == 256
+
+
+def test_shape_knob_requires_ladder():
+    with pytest.raises(ValueError):
+        Knob("bad", lambda: 1, lambda v: None, shape_safe=True)
+
+
+def test_knob_board_unknown_returns_none():
+    b = KnobBoard()
+    assert b.propose("nope", 1) is None
+
+
+# ---------------------------------------------------------------------------
+# Actuation log schema round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_actuation_log_round_trip(tmp_path):
+    path = tmp_path / "act.jsonl"
+    log = ActuationLog(str(path))
+    log.record(knob="audit_every", frm=1, to=8, outcome="applied",
+               policy="degradation_ladder", action="widen_audit",
+               direction="escalate", rung=1,
+               conditions=["slo_burn", "circuit_open"],
+               incident="inc-1-2-003")
+    log.record(knob="dispatch_size", frm=1024, to=None,
+               outcome="refused", policy="dispatch_resize",
+               action="resize_dispatch", direction="adapt", rung=1,
+               conditions=[], requested=300)
+    log.close()
+    records, problems = read_actuations(str(path))
+    assert problems == []
+    assert [r["seq"] for r in records] == [0, 1]
+    assert records[0]["schema"] == ACTUATION_SCHEMA
+    assert records[0]["conditions"] == ["circuit_open", "slo_burn"]
+    assert records[0]["incident"] == "inc-1-2-003"
+    assert records[1]["outcome"] == "refused"
+    assert records[1]["requested"] == 300
+    text, ok = actuation_report(str(path))
+    assert ok
+    assert "widen_audit" in text and "refused" in text
+
+
+def test_actuation_log_detects_tamper_and_bad_seq(tmp_path):
+    path = tmp_path / "act.jsonl"
+    log = ActuationLog(str(path))
+    for i in range(3):
+        log.record(knob="k", frm=i, to=i + 1, outcome="applied",
+                   policy="p", action="a", direction="adapt", rung=0,
+                   conditions=[])
+    log.close()
+    lines = path.read_text().splitlines()
+    doc = json.loads(lines[1])
+    doc["seq"] = 0  # duplicate/regressed sequence
+    doc["outcome"] = "mystery"
+    lines[1] = json.dumps(doc)
+    lines.append("{not json")
+    path.write_text("\n".join(lines) + "\n")
+    records, problems = read_actuations(str(path))
+    assert any("not monotonic" in p for p in problems)
+    assert any("unknown outcome" in p for p in problems)
+    assert any("bad json" in p for p in problems)
+    _text, ok = actuation_report(str(path))
+    assert not ok
+
+
+# ---------------------------------------------------------------------------
+# Engine-level: rung application over a live registry
+# ---------------------------------------------------------------------------
+
+
+def _fake_pipe(snap_every=64):
+    return SimpleNamespace(_audit_every=1, _snap_every=snap_every,
+                           _temporal=None, consumer=None)
+
+
+def _engine(tmp_path, clk, **kw):
+    t = obs.enable(Config(control_log=str(tmp_path / "act.jsonl"),
+                          metrics_interval_s=0.05))
+    eng = t.control
+    assert isinstance(eng, ControlEngine)
+    eng.stop()  # drive tick() manually, like the incident suite
+    eng2 = ControlEngine(t, str(tmp_path / "act2.jsonl"),
+                         dwell_s=kw.pop("dwell_s", 1.0),
+                         escalate_ticks=kw.pop("escalate_ticks", 2),
+                         clear_ticks=kw.pop("clear_ticks", 2),
+                         _clock=clk, **kw)
+    return t, eng2
+
+
+def test_engine_walks_ladder_and_restores(tmp_path):
+    clk = Clock()
+    t, eng = _engine(tmp_path, clk)
+    pipe = _fake_pipe()
+    eng.attach(pipe)
+    sick = t.registry.gauge("attendance_circuit_state",
+                            help="x", sink="store")
+    sick.set(1.0)  # OPEN -> pressure on every tick
+    for _ in range(30):
+        clk.t += 1.0
+        eng.tick(clk.t)
+        if eng.ladder.rung == 4:
+            break
+    assert eng.ladder.rung == 4
+    assert pipe._audit_every == 8          # rung 1
+    assert pipe._snap_every == 64 * 4      # rung 2
+    assert eng.admission.mode == "shed"    # rung 4 (no spill dir)
+    sick.set(0.0)  # healed
+    for _ in range(60):
+        clk.t += 1.0
+        eng.tick(clk.t)
+        if eng.ladder.rung == 0:
+            break
+    assert eng.ladder.rung == 0
+    assert pipe._audit_every == 1
+    assert pipe._snap_every == 64
+    assert eng.admission.mode == "pass"
+    records, problems = read_actuations(eng.log.path)
+    assert problems == []
+    rungs = [r for r in records if r["knob"] == "ladder.rung"]
+    assert [r["to"] for r in rungs[:4]] == [
+        "audit_wide", "snap_stretch", "temporal_pause", "shed"]
+    assert rungs[-1]["to"] == "normal"
+    # Every record carries the triggering conditions.
+    assert all("conditions" in r for r in records)
+    assert any("circuit_open" in r["conditions"] for r in records)
+    eng.log.close()
+
+
+def test_engine_dispatch_shape_ladder_refuses(tmp_path):
+    clk = Clock()
+    t, eng = _engine(tmp_path, clk)
+    consumer = SimpleNamespace(_dispatch_size=1024, lanes=[])
+    consumer.set_dispatch_size = \
+        lambda v: setattr(consumer, "_dispatch_size", int(v))
+    pipe = _fake_pipe()
+    pipe.consumer = consumer
+    eng.attach(pipe)
+    knob = eng.board.get("dispatch_size")
+    assert knob is not None and knob.ladder == (256, 512, 1024)
+    prop = knob.propose(300)
+    rec = eng._record(prop, policy="dispatch_resize",
+                      action="resize_dispatch", direction="adapt",
+                      conditions=[], incident=None)
+    assert prop.outcome == "refused"
+    assert consumer._dispatch_size == 1024
+    assert rec is not None and rec["outcome"] == "refused"
+    fams = {name: members for name, _k, _h, members
+            in t.registry.collect()}
+    refused = fams.get("attendance_control_refused_total")
+    assert refused and sum(m.value for m in refused) == 1
+    eng.log.close()
+
+
+def test_engine_spill_mode_with_dir(tmp_path):
+    clk = Clock()
+    t, eng = _engine(tmp_path, clk,
+                     spill_dir=str(tmp_path / "ingress"))
+    pipe = _fake_pipe()
+    eng.attach(pipe)
+    knob = eng.board.get("admission_mode")
+    assert knob.ladder == ("pass", "spill", "shed")
+    sick = t.registry.gauge("attendance_circuit_state",
+                            help="x", sink="store")
+    sick.set(1.0)
+    for _ in range(30):
+        clk.t += 1.0
+        eng.tick(clk.t)
+        if eng.ladder.rung == 4:
+            break
+    assert eng.admission.mode == "spill"
+    eng.log.close()
+
+
+# ---------------------------------------------------------------------------
+# Ingress admission spill/drain
+# ---------------------------------------------------------------------------
+
+
+def test_admission_spill_drain_retire(tmp_path):
+    adm = IngressAdmission(str(tmp_path / "spill"))
+    assert adm.admit(b"frame0") == "pass"  # mode starts open
+    adm.mode = "spill"
+    assert adm.admit(b"frame1") == "spill"
+    assert adm.admit(b"frame2") == "spill"
+    assert adm.pending_count == 2
+    batch = adm.drain_batch()
+    assert [p[1] for p in batch] == [b"frame1", b"frame2"]
+    assert adm.pending_count == 0
+    paths = [p for p, _ in batch]
+    assert all(p.exists() for p in paths)  # retire is the caller's
+    IngressAdmission.retire(paths)
+    assert not any(p.exists() for p in paths)
+
+
+def test_admission_adopts_crashed_spill(tmp_path):
+    d = tmp_path / "spill"
+    adm = IngressAdmission(str(d))
+    adm.mode = "spill"
+    adm.admit(b"orphan")
+    # New process over the same dir: the orphan must replay first.
+    adm2 = IngressAdmission(str(d))
+    assert adm2.pending_count == 1
+    assert adm2.drain_batch()[0][1] == b"orphan"
+
+
+def test_admission_shed_without_dir():
+    adm = IngressAdmission("")
+    adm.mode = "shed"
+    assert adm.admit(b"x") == "shed"
+    assert adm.shed_total == 1
+
+
+# ---------------------------------------------------------------------------
+# Satellites: SLO stage validation, diagnosis action wiring, doctor verb
+# ---------------------------------------------------------------------------
+
+
+def test_parse_slo_rejects_unknown_stage():
+    with pytest.raises(ValueError, match="unknown stage"):
+        parse_slo("persst_p99<=0.1")
+    with pytest.raises(ValueError, match="unknown stage"):
+        Config(slo=["bogus_p99<=1"]).validate()
+    # Known stages and aliases still parse.
+    assert parse_slo("dequeue_p99<=0.1").label_filter == \
+        ("stage", "dequeue_wait")
+    assert parse_slo("snapshot_blocked_p95<=1.0").quantile == 0.95
+    Config(slo=["sketch_p50<=0.5", "throughput>=1"]).validate()
+
+
+def test_every_rule_has_a_stable_action():
+    assert all(r.action for r in RULES)
+    ranked = diagnose({"circuit_open", "spill_growth"})
+    assert ranked[0]["rule"] == "persist_sink_down"
+    assert ranked[0]["action"] == "shed_ingress"
+
+
+def test_actuation_matches_semantics():
+    assert _actuation_matches("shed_ingress",
+                              {"action": "shed_ingress"})
+    assert not _actuation_matches("shed_ingress",
+                                  {"action": "widen_audit"})
+    # escalate_ladder is satisfied by any escalating ladder move.
+    assert _actuation_matches(
+        "escalate_ladder",
+        {"action": "widen_audit", "policy": "degradation_ladder",
+         "direction": "escalate"})
+    assert not _actuation_matches(
+        "escalate_ladder",
+        {"action": "widen_audit", "policy": "degradation_ladder",
+         "direction": "de-escalate"})
+
+
+def test_doctor_actuations_verb(tmp_path, capsys):
+    from attendance_tpu.cli import main as cli_main
+    path = tmp_path / "act.jsonl"
+    log = ActuationLog(str(path))
+    log.record(knob="audit_every", frm=1, to=8, outcome="applied",
+               policy="degradation_ladder", action="widen_audit",
+               direction="escalate", rung=1, conditions=["slo_burn"])
+    log.close()
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["doctor", "--actuations", str(path)])
+    assert exc.value.code in (0, None)
+    assert "actuation replay: ok" in capsys.readouterr().out
+    # A corrupt log exits 1.
+    path.write_text(path.read_text() + "{broken\n")
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["doctor", "--actuations", str(path)])
+    assert exc.value.code == 1
+    # A missing log exits 2.
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["doctor", "--actuations", str(tmp_path / "no.jsonl")])
+    assert exc.value.code == 2
+
+
+def test_striped_consumer_lane_rescale_surface():
+    """The lane_rescale policy's actuation surface: parking lanes is
+    clamped to [1, n], parked lanes report in active_lanes, and
+    re-opening resumes them."""
+    from attendance_tpu.pipeline.lanes import StripedConsumer
+    from attendance_tpu.transport.memory_broker import (
+        MemoryBroker, MemoryClient)
+
+    config = Config(ingress_lanes=3, batch_size=64,
+                    pulsar_topic="lanes-ctl").validate()
+    cons = StripedConsumer(config, MemoryClient(MemoryBroker()),
+                           "lanes-ctl", "sub")
+    try:
+        assert cons.active_lanes == 3
+        cons.set_active_lanes(1)
+        assert cons.active_lanes == 1
+        assert [lane.paused for lane in cons.lanes] == \
+            [False, True, True]
+        cons.set_active_lanes(0)  # clamped: never below one lane
+        assert cons.active_lanes == 1
+        cons.set_active_lanes(99)  # clamped to the configured width
+        assert cons.active_lanes == 3
+        assert not any(lane.paused for lane in cons.lanes)
+    finally:
+        cons.close()
+
+
+def test_incident_report_cross_references_actuations(tmp_path):
+    """`doctor --incident` + `--actuations`: the report says whether
+    the recorded actuations matched the top-ranked rule's action."""
+    from attendance_tpu.obs.incident import incident_report
+
+    t = obs.enable(Config(incident_dir=str(tmp_path / "incidents")))
+    eng = t.incidents
+    eng.stop()
+    eng.dir.mkdir(parents=True, exist_ok=True)
+    t.registry.gauge("attendance_circuit_state", sink="disk").set(1.0)
+    eng.tick()
+    iid = eng.tick()  # sink_circuit_open -> action shed_ingress
+    assert iid is not None
+
+    path = tmp_path / "act.jsonl"
+    log = ActuationLog(str(path))
+    log.record(knob="admission_mode", frm="pass", to="shed",
+               outcome="applied", policy="degradation_ladder",
+               action="shed_ingress", direction="escalate", rung=4,
+               conditions=["circuit_open"], incident=iid)
+    log.close()
+    text, ok = incident_report(eng.dir, actuation_log=str(path))
+    assert ok
+    assert "matched top rule (shed_ingress)" in text
+
+    # A log with no matching action warns but does not fail the
+    # replay (the bundle may predate the controller).
+    miss = tmp_path / "miss.jsonl"
+    log = ActuationLog(str(miss))
+    log.record(knob="audit_every", frm=1, to=8, outcome="applied",
+               policy="degradation_ladder", action="widen_audit",
+               direction="escalate", rung=1, conditions=[],
+               incident=iid)
+    log.close()
+    text, ok = incident_report(eng.dir, actuation_log=str(miss))
+    assert ok
+    assert "no recorded actuation for shed_ingress" in text
+
+
+def test_config_control_flags_validated():
+    with pytest.raises(ValueError, match="control_dwell_s"):
+        Config(control_log="/tmp/a", control_dwell_s=0).validate()
+    with pytest.raises(ValueError, match="control_spill_dir"):
+        Config(control_spill_dir="/tmp/s").validate()
+    Config(control_log="/tmp/a",
+           control_spill_dir="/tmp/s").validate()

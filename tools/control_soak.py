@@ -1,0 +1,356 @@
+"""CI control soak (ISSUE 20): the controller must SAVE a run that
+static flags LOSE.
+
+Three runs over the identical frame script (overload burst + trickle)
+and the identical fault script (``persist_fail=1.0`` plus a transport
+partition window for the first ``HEAL_S`` seconds, then healed):
+
+1. an **oracle** run — no faults, no controller — pins the expected
+   final state (HLL counts per lecture day, deduped rows, valid
+   totals);
+2. a **static-baseline** run — same faults, flags frozen, no spill
+   buffer, no controller. Inserts raise through the retry bound and
+   dead-letter: acked events are LOST and the final state diverges
+   from the oracle. The soak REQUIRES this breach — if static flags
+   survive the script, the comparison proves nothing;
+3. a **controlled** run — same faults, plus the persist spill buffer
+   and the control plane (``control_log`` + ``control_spill_dir``).
+   The breaker opens, the ladder escalates through audit widening /
+   snapshot stretching to ingress admission (durable spill-and-ack),
+   the heal lands, the half-open probe closes the circuit, the ladder
+   de-escalates, and both spill buffers drain.
+
+Gates on the controlled run:
+
+* the ladder actually escalated (>= 1 escalate transition recorded)
+  and settled back to ``normal`` (rung 0) — bounded flapping is
+  enforced by a hard cap on total actuation records;
+* circuit CLOSED at end, persist spill drained, ingress spill drained;
+* zero acked-event loss: final state == oracle exactly, and nothing
+  dead-lettered;
+* ``doctor --actuations`` replays the actuation log and exits 0
+  (schema + monotonic sequence intact);
+* ``doctor --recompile-ceiling 0`` over the run's prom artifact —
+  every actuation stayed inside the pre-warmed shape ladder, so the
+  steady state recompiled NOTHING.
+
+The workdir (actuation log, both spill dirs, prom file) ships as a CI
+triage artifact on failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+NUM_EVENTS = 1 << 15
+FRAME_SIZE = 512
+LECTURES = 4
+BURST_FRAMES = 16       # overload: sent before the pipeline starts
+TRICKLE_S = 0.08        # per-frame spacing for the live tail
+HEAL_S = 2.5            # fault window; identical in baseline/controlled
+FAULT_SPEC = "persist_fail=1.0,partition=300ms:0.01"
+MAX_ACTUATIONS = 200    # bounded-flapping ceiling
+
+
+def _frames(seed: int):
+    from attendance_tpu.pipeline.loadgen import generate_frames
+    return generate_frames(NUM_EVENTS, FRAME_SIZE,
+                           roster_size=min(50_000, NUM_EVENTS),
+                           num_lectures=LECTURES, seed=1_700 + seed)
+
+
+def _state(pipe) -> dict:
+    counts = {int(d): pipe.count(int(d)) for d in pipe.lecture_days()}
+    df = pipe.store.to_dataframe()
+    return {"counts": counts, "rows": len(df),
+            "valid": int(df.is_valid.sum())}
+
+
+def _drive(pipe, cfg, frames, *, heal=None, max_seconds=120.0,
+           idle_timeout_s=2.0):
+    """Overload burst + live trickle against a running pipeline, with
+    the heal callback fired at HEAL_S. Returns (terminated, errors)."""
+    producer = pipe.client.create_producer(cfg.pulsar_topic)
+    frames = list(frames)
+    for f in frames[:BURST_FRAMES]:
+        producer.send(f)
+
+    done = threading.Event()
+    errors = []
+
+    def _run():
+        try:
+            pipe.run(idle_timeout_s=idle_timeout_s)
+        except BaseException as exc:  # noqa: BLE001 — report, don't hang
+            errors.append(exc)
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=_run, name="soak-pipeline",
+                              daemon=True)
+    worker.start()
+    t0 = time.monotonic()
+    healed = heal is None
+    for f in frames[BURST_FRAMES:]:
+        if not healed and time.monotonic() - t0 >= HEAL_S:
+            heal()
+            healed = True
+        producer.send(f)
+        time.sleep(TRICKLE_S)
+        if done.is_set():
+            break
+    if not healed:
+        # Short trickle (or early exit): the fault window still ends.
+        remaining = HEAL_S - (time.monotonic() - t0)
+        if remaining > 0:
+            time.sleep(remaining)
+        heal()
+    terminated = done.wait(timeout=max_seconds)
+    return terminated, errors
+
+
+def _oracle(seed: int) -> dict:
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+
+    cfg = Config(bloom_filter_capacity=50_000)
+    pipe = FusedPipeline(cfg, num_banks=LECTURES)
+    roster, frames = _frames(seed)
+    pipe.preload(roster)
+    producer = pipe.client.create_producer(cfg.pulsar_topic)
+    for f in frames:
+        producer.send(f)
+    pipe.run(idle_timeout_s=1.0)
+    state = _state(pipe)
+    pipe.cleanup()
+    return state
+
+
+def _baseline(seed: int, work: Path, failures) -> dict:
+    """Static flags under the fault script: no spill buffer, no
+    controller. The run must BREACH (dead-letters + state divergence)
+    — that breach is what the controlled run is judged against."""
+    from attendance_tpu import chaos, obs
+    from attendance_tpu.config import Config
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+
+    cfg = Config(bloom_filter_capacity=50_000,
+                 chaos=FAULT_SPEC, chaos_seed=seed,
+                 quarantine_dir=str(work / "baseline-dlq"),
+                 max_redeliveries=2, retry_budget_s=1.0).validate()
+    inj = chaos.ensure(cfg)
+    pipe = FusedPipeline(cfg, num_banks=LECTURES)
+    roster, frames = _frames(seed)
+    pipe.preload(roster)
+
+    def heal():
+        # ChaosSpec is frozen; the injector reads ``spec`` live on
+        # every roll, so swapping it heals the sink mid-run.
+        inj.spec = dataclasses.replace(inj.spec, persist_fail=0.0,
+                                       partition=0.0)
+
+    terminated, errors = _drive(pipe, cfg, frames, heal=heal)
+    if not terminated or errors:
+        failures.append(f"baseline run wedged/raised: {errors!r}")
+        return {}
+    state = _state(pipe)
+    dead = pipe.metrics.dead_lettered
+    pipe.cleanup()
+    chaos.disable()
+    obs.disable()
+    print(f"[control_soak] baseline: dead_lettered={dead} "
+          f"state={state}")
+    return {"state": state, "dead_lettered": dead}
+
+
+def _controlled(seed: int, work: Path, failures) -> dict:
+    from attendance_tpu import chaos, obs
+    from attendance_tpu.config import Config
+    from attendance_tpu.control import read_actuations
+    from attendance_tpu.pipeline.fast_path import FusedPipeline
+
+    act_log = work / "actuations.jsonl"
+    prom = work / "metrics.prom"
+    ingress = work / "ingress-spill"
+    cfg = Config(bloom_filter_capacity=50_000,
+                 chaos=FAULT_SPEC, chaos_seed=seed,
+                 quarantine_dir=str(work / "controlled-dlq"),
+                 max_redeliveries=2, retry_budget_s=1.0,
+                 persist_spill_dir=str(work / "persist-spill"),
+                 persist_breaker_failures=2,
+                 persist_breaker_cooldown_s=0.25,
+                 snapshot_dir=str(work / "snaps"),
+                 snapshot_mode="delta", snapshot_every_batches=8,
+                 control_log=str(act_log),
+                 control_spill_dir=str(ingress),
+                 # Each half-open probe cycle under a still-sick sink
+                 # costs TWO ladder transitions (shed -> probe ->
+                 # shed); with a 0.25 s breaker cooldown the default
+                 # flap limit of 8/min would freeze the ladder at shed
+                 # before the heal lands. Budget ~8 probe cycles.
+                 control_dwell_s=0.3, control_clear_ticks=2,
+                 control_flap_limit=24,
+                 metrics_prom=str(prom),
+                 metrics_interval_s=0.1).validate()
+    telemetry = obs.enable(cfg)
+    inj = chaos.ensure(cfg)
+    pipe = FusedPipeline(cfg, num_banks=LECTURES)
+    roster, frames = _frames(seed)
+    pipe.preload(roster)
+
+    def heal():
+        # ChaosSpec is frozen; the injector reads ``spec`` live on
+        # every roll, so swapping it heals the sink mid-run.
+        inj.spec = dataclasses.replace(inj.spec, persist_fail=0.0,
+                                       partition=0.0)
+
+    terminated, errors = _drive(pipe, cfg, frames, heal=heal)
+    report: dict = {}
+    try:
+        if not terminated or errors:
+            failures.append(f"controlled run wedged/raised: {errors!r}")
+            return report
+
+        # Let the controller settle: pressure is gone, so the ladder
+        # must walk back to rung 0 (dwell-paced) on its own.
+        eng = telemetry.control
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and eng.ladder.rung != 0:
+            time.sleep(0.2)
+        rung = eng.ladder.rung
+        if rung != 0:
+            failures.append(
+                f"controller never de-escalated (rung {rung} "
+                f"after settle window)")
+
+        store = pipe.store
+        if store.breaker.opened_total == 0:
+            failures.append("persist_fail never opened the circuit "
+                            "(fault script not wired?)")
+        if store.breaker.state != "closed":
+            failures.append(f"circuit ended {store.breaker.state!r}, "
+                            f"not closed")
+        if store.spill_pending != 0:
+            failures.append(f"{store.spill_pending} persist spill "
+                            f"batch(es) stranded")
+        stranded = sorted(ingress.glob("ingress-*.bin")) \
+            if ingress.is_dir() else []
+        if stranded:
+            failures.append(f"{len(stranded)} ingress spill file(s) "
+                            f"stranded: {[p.name for p in stranded]}")
+        if pipe.metrics.dead_lettered:
+            failures.append(f"controlled run dead-lettered "
+                            f"{pipe.metrics.dead_lettered} frame(s) "
+                            f"(acked loss)")
+
+        report["state"] = _state(pipe)
+        report["spilled"] = store.spilled_total
+        report["drained"] = store.drained_total
+        report["circuit_opened"] = store.breaker.opened_total
+        report["ingress_spilled"] = eng.admission.spilled_total
+        report["shed"] = eng.admission.shed_total
+    finally:
+        pipe.cleanup()
+        chaos.disable()
+        obs.disable()  # final prom write + actuation log close
+
+    records, problems = read_actuations(str(act_log))
+    report["actuations"] = len(records)
+    for p in problems:
+        failures.append(f"actuation log: {p}")
+    escalations = [r for r in records
+                   if r["knob"] == "ladder.rung"
+                   and r["direction"] == "escalate"]
+    if not escalations:
+        failures.append("controller never escalated the ladder under "
+                        "the fault script")
+    if records and len(records) > MAX_ACTUATIONS:
+        failures.append(f"{len(records)} actuations recorded — "
+                        f"flapping (cap {MAX_ACTUATIONS})")
+    peak = max((r["rung"] for r in records), default=0)
+    report["peak_rung"] = peak
+    print(f"[control_soak] controlled: {report}")
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="control-plane chaos soak")
+    ap.add_argument("--workdir", default="/tmp/control_soak")
+    ap.add_argument("--seed", type=int, default=20)
+    args = ap.parse_args()
+
+    work = Path(args.workdir)
+    work.mkdir(parents=True, exist_ok=True)
+
+    from attendance_tpu import chaos, obs
+
+    chaos.disable()
+    obs.disable()
+    failures: list = []
+
+    want = _oracle(args.seed)
+    print(f"[control_soak] oracle: {want}")
+
+    base = _baseline(args.seed, work, failures)
+    if base:
+        # The baseline MUST breach — acked loss under static flags is
+        # the condition the controller exists to prevent.
+        if base["dead_lettered"] == 0:
+            failures.append("baseline dead-lettered nothing — fault "
+                            "script too soft to prove anything")
+        if base["state"] == want:
+            failures.append("baseline state equals oracle — static "
+                            "flags survived; comparison is vacuous")
+
+    ctl = _controlled(args.seed, work, failures)
+    if ctl.get("state") is not None and ctl["state"] != want:
+        failures.append(f"controlled state diverged from oracle: "
+                        f"{ctl['state']} != {want}")
+
+    # Offline replay verbs, exactly as CI would run them.
+    from attendance_tpu.cli import main as cli_main
+
+    def _cli(argv):
+        try:
+            cli_main(argv)
+            return 0
+        except SystemExit as exc:
+            return int(exc.code or 0)
+
+    act_log = work / "actuations.jsonl"
+    if act_log.is_file():
+        code = _cli(["doctor", "--actuations", str(act_log)])
+        if code != 0:
+            failures.append(f"doctor --actuations exited {code}")
+    else:
+        failures.append("no actuation log written")
+
+    prom = work / "metrics.prom"
+    if prom.is_file():
+        code = _cli(["doctor", str(prom), "--recompile-ceiling", "0"])
+        if code != 0:
+            failures.append(
+                f"doctor --recompile-ceiling 0 exited {code} — a "
+                f"shape-changing actuation escaped the ladder")
+    else:
+        failures.append("no prom artifact written")
+
+    if failures:
+        print("[control_soak] FAIL")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("[control_soak] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
